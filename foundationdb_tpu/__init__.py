@@ -1,0 +1,23 @@
+"""foundationdb_tpu — a TPU-native distributed transactional key-value store.
+
+A ground-up rebuild of the capabilities of FoundationDB 6.0 (reference:
+/root/reference), designed TPU-first:
+
+- The resolver's MVCC conflict detection (reference: fdbserver/SkipList.cpp,
+  behind fdbserver/ConflictSet.h:28 ``newConflictSet()``) is a vectorized
+  JAX/XLA interval-overlap kernel over an HBM-resident versioned write-range
+  index (:mod:`foundationdb_tpu.conflict`).
+- The surrounding system — version assignment, commit pipeline, replicated
+  write-ahead logging, sharded multi-version storage, deterministic-simulation
+  testing — is rebuilt on a deterministic actor runtime
+  (:mod:`foundationdb_tpu.runtime`, the analog of the reference's flow/).
+
+Layer map (mirrors SURVEY.md §1):
+  runtime/   — actor runtime: futures, virtual-time event loop, RNG, trace, knobs
+  net/       — RPC endpoints + deterministic network simulation (fdbrpc/ analog)
+  conflict/  — ConflictSet backends: TPU kernel, C++ skip list, Python oracle
+  server/    — roles: master, proxy, resolver, tlog, storage, cluster assembly
+  client/    — Database/Transaction API with read-your-writes semantics
+"""
+
+__version__ = "0.1.0"

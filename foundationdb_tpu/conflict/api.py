@@ -1,0 +1,97 @@
+"""ConflictSet API — the narrow factory seam the commit path builds on.
+
+Mirrors the reference's ``fdbserver/ConflictSet.h:27-60`` (``newConflictSet()``
+/ ``ConflictBatch``): the resolver (server/resolver.py) talks only to this
+interface, so backends are interchangeable:
+
+- ``oracle`` — pure-Python reference implementation (the analog of the
+  reference's ``SlowConflictSet``, SkipList.cpp:59-88). Ground truth for
+  differential tests; O(N) per query.
+- ``native`` — C++ versioned skip list via ctypes (conflict/native.py), the
+  CPU baseline the TPU backend is benchmarked against.
+- ``tpu`` — the JAX/XLA vectorized interval-overlap kernel over an
+  HBM-resident versioned write-range index (conflict/tpu_backend.py).
+
+Transaction semantics (reference ``ConflictBatch::addTransaction``
+SkipList.cpp:979 and ``detectConflicts`` SkipList.cpp:1163):
+
+1. A transaction whose ``read_snapshot`` is older than the set's
+   ``oldest_version`` *and* that has read conflict ranges is TOO_OLD.
+2. A read range [begin, end) conflicts if some write range committed at
+   version > read_snapshot overlaps it (history check).
+3. Transactions are then scanned in batch order: a transaction also conflicts
+   if any of its read ranges overlaps a write range of an *earlier,
+   committed* transaction of the same batch (intra-batch check,
+   SkipList.cpp:1133).
+4. Write ranges of committed transactions are merged into the history at
+   version ``now``; history below ``new_oldest_version`` is garbage-collected.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Verdict(enum.IntEnum):
+    COMMITTED = 0
+    CONFLICT = 1
+    TOO_OLD = 2
+
+
+@dataclass
+class CommitTransaction:
+    """Wire-format analog of fdbclient/CommitTransaction.h:27-60 (conflict part)."""
+
+    read_snapshot: int = 0
+    read_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    write_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+
+
+class ConflictSet:
+    """Abstract versioned write-range history. One per resolver key-partition."""
+
+    def __init__(self) -> None:
+        self.oldest_version = 0
+
+    def detect_batch(
+        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
+    ) -> list[Verdict]:
+        raise NotImplementedError
+
+    def clear(self, version: int) -> None:
+        """Reset history (reference clearConflictSet, SkipList.cpp:1097)."""
+        raise NotImplementedError
+
+
+class ConflictBatch:
+    """Collects one commit batch, then resolves it — API parity with the
+    reference's ConflictBatch (ConflictSet.h:40-60)."""
+
+    def __init__(self, cs: ConflictSet) -> None:
+        self._cs = cs
+        self._transactions: list[CommitTransaction] = []
+
+    def add_transaction(self, tr: CommitTransaction) -> int:
+        self._transactions.append(tr)
+        return len(self._transactions) - 1
+
+    def detect_conflicts(self, now: int, new_oldest_version: int) -> list[Verdict]:
+        return self._cs.detect_batch(self._transactions, now, new_oldest_version)
+
+
+def new_conflict_set(backend: str = "oracle", **kwargs) -> ConflictSet:
+    """The ``newConflictSet()`` factory seam (ConflictSet.h:28)."""
+    if backend == "oracle":
+        from .oracle import OracleConflictSet
+
+        return OracleConflictSet(**kwargs)
+    if backend == "native":
+        from .native import NativeConflictSet
+
+        return NativeConflictSet(**kwargs)
+    if backend == "tpu":
+        from .tpu_backend import TpuConflictSet
+
+        return TpuConflictSet(**kwargs)
+    raise ValueError(f"unknown conflict-set backend {backend!r}")

@@ -1,0 +1,87 @@
+"""Order-preserving fixed-width key encoding for device-resident indexes.
+
+The reference's conflict index (fdbserver/SkipList.cpp) keys its skip list on
+variable-length byte strings and compares them with ``KeyInfo`` ordering rules
+(SkipList.cpp:147-177, including the ``\\x00``-append point-range edge cases).
+A TPU kernel needs fixed shapes, so keys are encoded into a fixed-width code
+that preserves lexicographic order for all keys up to ``width - 1`` bytes:
+
+    code = key[:width-1] zero-padded to width-1 bytes, then min(len(key), width-1)
+
+Why this is order-preserving (keys of length <= width-1):
+- Two distinct keys of equal length differ somewhere in the first width-1
+  bytes, and zero padding does not disturb byte-wise comparison past that.
+- If ``a`` is a proper prefix of ``b``, their padded prefixes compare equal
+  and the trailing length byte breaks the tie the right way (shorter < longer).
+  In particular ``k`` < ``k + b"\\x00"`` survives encoding, which is what makes
+  FoundationDB point-write ranges ``[k, k+\\x00)`` non-empty after encoding.
+
+Keys longer than width-1 bytes are truncated: two long keys sharing the first
+width-1 bytes encode equal, which can only *merge* distinct keys — a
+conservative approximation that may add false conflicts but never misses one.
+(Default width is 32 → exact for keys up to 31 bytes; the reference's own
+benchmark keys — benchmarking.rst:22 — are 16 bytes.)
+
+Device layout: each code is ``width // 4`` big-endian uint32 lanes, so
+lexicographic byte order == lexicographic lane order, and an N-key index is a
+``uint32[N, width//4]`` tensor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_KEY_WIDTH = 32  # bytes per code, including the trailing length byte
+
+
+def lanes_for_width(width: int) -> int:
+    if width % 4 != 0 or width < 8:
+        raise ValueError(f"key width must be a multiple of 4 and >= 8, got {width}")
+    return width // 4
+
+
+def encode_key(key: bytes, width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
+    """Encode one key into uint32 big-endian lanes (shape [width//4])."""
+    return encode_keys([key], width)[0]
+
+
+def encode_keys(keys: list[bytes], width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
+    """Encode a batch of keys → uint32[len(keys), width//4], order-preserving."""
+    lanes_for_width(width)  # validate
+    n = len(keys)
+    buf = np.zeros((n, width), dtype=np.uint8)
+    for i, k in enumerate(keys):
+        m = min(len(k), width - 1)
+        if m:
+            buf[i, :m] = np.frombuffer(k, dtype=np.uint8, count=m)
+        # Clamp the length byte at width-1: every truncated key collapses to
+        # the same code as its width-1-byte prefix, so truncation can only
+        # MERGE keys (conservative), never reorder them. (An unclamped length
+        # would order b"p"*31+b"z" before the byte-wise-smaller b"p"*31+b"aa".)
+        buf[i, width - 1] = min(len(k), width - 1)
+    return pack_lanes(buf)
+
+
+def pack_lanes(codes_u8: np.ndarray) -> np.ndarray:
+    """uint8[N, width] → big-endian uint32[N, width//4] (order-preserving)."""
+    n, width = codes_u8.shape
+    lanes = codes_u8.reshape(n, width // 4, 4).astype(np.uint32)
+    return (lanes[..., 0] << 24) | (lanes[..., 1] << 16) | (lanes[..., 2] << 8) | lanes[..., 3]
+
+
+def max_sentinel(width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
+    """A code strictly greater than every encodable key: all-0xFF lanes.
+
+    (Only keys starting with width-1 bytes of 0xFF could encode to it, and
+    real keyspace stays below the ``\\xff\\xff`` system-key prefix.)
+    Used to pad unused index capacity so searchsorted lands before it.
+    """
+    return np.full((lanes_for_width(width),), 0xFFFFFFFF, dtype=np.uint32)
+
+
+def compare_codes(a: np.ndarray, b: np.ndarray) -> int:
+    """Lexicographic comparison of two lane codes: -1 / 0 / +1 (host-side)."""
+    for x, y in zip(a.tolist(), b.tolist()):
+        if x != y:
+            return -1 if x < y else 1
+    return 0

@@ -1,0 +1,128 @@
+"""Pure-Python oracle ConflictSet — ground truth for differential testing.
+
+The analog of the reference's ``SlowConflictSet`` (SkipList.cpp:59-88), which
+keeps a KeyRangeMap of versions and answers "max committed-write version over
+a key range". Here the history is a step function over raw byte-string
+keyspace, stored as a sorted list of (boundary_key, max_version_of_gap_right).
+
+Deliberately simple (bisect + linear sweeps) — correctness reference only.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from .api import CommitTransaction, ConflictSet, Verdict
+
+
+class _StepFunction:
+    """Map from key (bytes) to int version: piecewise constant, half-open gaps.
+
+    boundaries[i] is the start of gap i; gap i spans [boundaries[i],
+    boundaries[i+1]) (the last gap is unbounded). values[i] is the max write
+    version recorded over that gap; 0 means "never written".
+    """
+
+    def __init__(self) -> None:
+        self.boundaries: list[bytes] = [b""]
+        self.values: list[int] = [0]
+
+    def _locate(self, key: bytes) -> int:
+        """Index of the gap containing key."""
+        return bisect.bisect_right(self.boundaries, key) - 1
+
+    def _ensure_boundary(self, key: bytes) -> int:
+        i = self._locate(key)
+        if self.boundaries[i] != key:
+            self.boundaries.insert(i + 1, key)
+            self.values.insert(i + 1, self.values[i])
+            return i + 1
+        return i
+
+    def max_over(self, begin: bytes, end: bytes) -> int:
+        if begin >= end:
+            return 0
+        lo = self._locate(begin)
+        hi = bisect.bisect_left(self.boundaries, end, lo=lo + 1) - 1
+        return max(self.values[lo : hi + 1])
+
+    def raise_to(self, begin: bytes, end: bytes, version: int) -> None:
+        if begin >= end:
+            return
+        lo = self._ensure_boundary(begin)
+        hi = bisect.bisect_left(self.boundaries, end, lo=lo + 1)
+        if hi == len(self.boundaries) or self.boundaries[hi] != end:
+            # hi is the first boundary > end's gap start; split end's gap
+            self.boundaries.insert(hi, end)
+            self.values.insert(hi, self.values[hi - 1])
+        for i in range(lo, hi):
+            if self.values[i] < version:
+                self.values[i] = version
+
+    def forget_below(self, version: int) -> None:
+        """GC: gaps whose version is below ``version`` can never conflict with
+        a non-too-old read, so flatten them to 0 and coalesce."""
+        for i, v in enumerate(self.values):
+            if v < version:
+                self.values[i] = 0
+        bs, vs = [self.boundaries[0]], [self.values[0]]
+        for b, v in zip(self.boundaries[1:], self.values[1:]):
+            if v != vs[-1]:
+                bs.append(b)
+                vs.append(v)
+        self.boundaries, self.values = bs, vs
+
+
+def _overlaps(a_begin: bytes, a_end: bytes, b_begin: bytes, b_end: bytes) -> bool:
+    return a_begin < b_end and b_begin < a_end
+
+
+class OracleConflictSet(ConflictSet):
+    def __init__(self) -> None:
+        super().__init__()
+        self._history = _StepFunction()
+
+    def clear(self, version: int) -> None:
+        self._history = _StepFunction()
+        self.oldest_version = version
+
+    def detect_batch(
+        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
+    ) -> list[Verdict]:
+        verdicts: list[Verdict] = []
+        # Phases 1-2: too-old + history check (SkipList.cpp:989,1210).
+        for tr in transactions:
+            if tr.read_snapshot < self.oldest_version and tr.read_conflict_ranges:
+                verdicts.append(Verdict.TOO_OLD)
+                continue
+            conflict = any(
+                self._history.max_over(b, e) > tr.read_snapshot
+                for (b, e) in tr.read_conflict_ranges
+            )
+            verdicts.append(Verdict.CONFLICT if conflict else Verdict.COMMITTED)
+
+        # Phase 3: intra-batch, in order, against earlier *committed* writes
+        # (SkipList.cpp:1133 checkIntraBatchConflicts).
+        committed_writes: list[tuple[bytes, bytes]] = []
+        for t, tr in enumerate(transactions):
+            if verdicts[t] == Verdict.COMMITTED:
+                hit = any(
+                    _overlaps(rb, re, wb, we)
+                    for (rb, re) in tr.read_conflict_ranges
+                    for (wb, we) in committed_writes
+                )
+                if hit:
+                    verdicts[t] = Verdict.CONFLICT
+            if verdicts[t] == Verdict.COMMITTED:
+                committed_writes.extend(tr.write_conflict_ranges)
+
+        # Phases 4-5: merge committed writes at ``now``; advance GC horizon
+        # (SkipList.cpp:1260 mergeWriteConflictRanges, :1195 removeBefore).
+        for t, tr in enumerate(transactions):
+            if verdicts[t] == Verdict.COMMITTED:
+                for (wb, we) in tr.write_conflict_ranges:
+                    self._history.raise_to(wb, we, now)
+        if new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+            self._history.forget_below(new_oldest_version)
+        return verdicts

@@ -1,0 +1,124 @@
+"""Oracle ConflictSet semantics: the ground-truth behaviors every backend
+must reproduce (reference semantics: fdbserver/SkipList.cpp detectConflicts)."""
+
+from foundationdb_tpu.conflict.api import (
+    CommitTransaction,
+    ConflictBatch,
+    Verdict,
+    new_conflict_set,
+)
+
+
+def tx(snapshot, reads=(), writes=()):
+    return CommitTransaction(
+        read_snapshot=snapshot,
+        read_conflict_ranges=list(reads),
+        write_conflict_ranges=list(writes),
+    )
+
+
+def detect(cs, txs, now, oldest):
+    b = ConflictBatch(cs)
+    for t in txs:
+        b.add_transaction(t)
+    return b.detect_conflicts(now, oldest)
+
+
+def test_basic_history_conflict():
+    cs = new_conflict_set("oracle")
+    # batch 1: blind write to [a, b) at version 10
+    assert detect(cs, [tx(5, writes=[(b"a", b"b")])], 10, 0) == [Verdict.COMMITTED]
+    # read at snapshot 9 overlapping the write → conflict; snapshot 10 → fine
+    assert detect(cs, [tx(9, reads=[(b"aa", b"ab")])], 11, 0) == [Verdict.CONFLICT]
+    assert detect(cs, [tx(10, reads=[(b"aa", b"ab")])], 12, 0) == [Verdict.COMMITTED]
+    # non-overlapping read → fine
+    assert detect(cs, [tx(9, reads=[(b"b", b"c")])], 13, 0) == [Verdict.COMMITTED]
+
+
+def test_point_write_point_read():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"k", b"k\x00")])], 5, 0)
+    assert detect(cs, [tx(4, reads=[(b"k", b"k\x00")])], 6, 0) == [Verdict.CONFLICT]
+    assert detect(cs, [tx(4, reads=[(b"k\x00", b"k\x01")])], 7, 0) == [Verdict.COMMITTED]
+
+
+def test_too_old():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"a", b"b")])], 10, 8)  # advances oldest to 8
+    assert detect(cs, [tx(5, reads=[(b"x", b"y")])], 11, 8) == [Verdict.TOO_OLD]
+    # blind writes (no read ranges) are never too old (SkipList.cpp:989)
+    assert detect(cs, [tx(5, writes=[(b"x", b"y")])], 12, 8) == [Verdict.COMMITTED]
+
+
+def test_intra_batch_order_dependence():
+    cs = new_conflict_set("oracle")
+    # t0 writes [a,b); t1 reads [a,b) in the same batch → t1 conflicts
+    out = detect(
+        cs,
+        [tx(0, writes=[(b"a", b"b")]), tx(0, reads=[(b"a", b"b")])],
+        5,
+        0,
+    )
+    assert out == [Verdict.COMMITTED, Verdict.CONFLICT]
+
+    cs2 = new_conflict_set("oracle")
+    # reversed order: reader first → both commit
+    out = detect(
+        cs2,
+        [tx(0, reads=[(b"a", b"b")]), tx(0, writes=[(b"a", b"b")])],
+        5,
+        0,
+    )
+    assert out == [Verdict.COMMITTED, Verdict.COMMITTED]
+
+
+def test_intra_batch_conflicted_writer_does_not_poison():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"a", b"b")])], 10, 0)
+    # t0 conflicts on history; its write must NOT be merged nor count
+    # against t1's intra-batch check (SkipList.cpp:1150 only sets committed)
+    out = detect(
+        cs,
+        [
+            tx(5, reads=[(b"a", b"a\x00")], writes=[(b"q", b"r")]),
+            tx(10, reads=[(b"q", b"r")]),
+        ],
+        11,
+        0,
+    )
+    assert out == [Verdict.CONFLICT, Verdict.COMMITTED]
+    # and [q, r) never entered history
+    assert detect(cs, [tx(10, reads=[(b"q", b"r")])], 12, 0) == [Verdict.COMMITTED]
+
+
+def test_gc_forgets_old_versions():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"a", b"b")])], 10, 0)
+    # advance oldest beyond 10 → history below is forgotten
+    detect(cs, [tx(11, writes=[(b"z", b"zz")])], 20, 15)
+    # snapshot 14 < oldest 15 → TOO_OLD (not conflict)
+    assert detect(cs, [tx(14, reads=[(b"a", b"b")])], 21, 15) == [Verdict.TOO_OLD]
+    # snapshot >= oldest sees no conflict from the forgotten write
+    assert detect(cs, [tx(16, reads=[(b"a", b"b")])], 22, 15) == [Verdict.COMMITTED]
+
+
+def test_adjacent_ranges_do_not_conflict():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"b", b"c")])], 5, 0)
+    assert detect(cs, [tx(0, reads=[(b"a", b"b")])], 6, 0) == [Verdict.COMMITTED]
+    assert detect(cs, [tx(0, reads=[(b"c", b"d")])], 7, 0) == [Verdict.COMMITTED]
+
+
+def test_empty_transaction_commits():
+    cs = new_conflict_set("oracle")
+    assert detect(cs, [tx(0)], 5, 0) == [Verdict.COMMITTED]
+
+
+def test_overlapping_writes_merge_max_version():
+    cs = new_conflict_set("oracle")
+    detect(cs, [tx(0, writes=[(b"a", b"m")])], 10, 0)
+    detect(cs, [tx(10, writes=[(b"g", b"z")])], 20, 0)
+    # overlap region [g, m) now at version 20
+    assert detect(cs, [tx(15, reads=[(b"h", b"i")])], 21, 0) == [Verdict.CONFLICT]
+    # [a, g) still at version 10
+    assert detect(cs, [tx(15, reads=[(b"b", b"c")])], 22, 0) == [Verdict.COMMITTED]

@@ -1,0 +1,81 @@
+"""Order-preserving key encoding: exactness up to width-1 bytes."""
+
+import random
+
+import numpy as np
+
+from foundationdb_tpu.conflict import keys as K
+
+
+def _cmp_codes(a, b):
+    return K.compare_codes(a, b)
+
+
+def test_roundtrip_ordering_exhaustive_short():
+    ks = [b"", b"\x00", b"\x00\x00", b"a", b"a\x00", b"ab", b"b", b"\xff", b"\xff\xff"]
+    codes = K.encode_keys(ks, width=8)
+    for i, a in enumerate(ks):
+        for j, b in enumerate(ks):
+            want = (a > b) - (a < b)
+            got = _cmp_codes(codes[i], codes[j])
+            assert got == want, (a, b, got, want)
+
+
+def test_point_range_nonempty_after_encoding():
+    # FoundationDB point writes are [k, k + b"\x00"); these must stay non-empty.
+    for k in [b"", b"x", b"hello", b"\x00\x00", b"\xfe" * 30]:
+        a, b = K.encode_keys([k, k + b"\x00"], width=32)
+        assert _cmp_codes(a, b) == -1
+
+
+def test_random_ordering_matches_bytes():
+    rnd = random.Random(7)
+    ks = [
+        bytes(rnd.randrange(256) for _ in range(rnd.randrange(0, 20)))
+        for _ in range(300)
+    ]
+    codes = K.encode_keys(ks, width=32)
+    order_by_bytes = sorted(range(len(ks)), key=lambda i: ks[i])
+    order_by_code = sorted(
+        range(len(ks)), key=lambda i: tuple(codes[i].tolist() + [ks[i]])
+    )
+    # codes must sort identically (ties in code only between equal keys,
+    # impossible here below width-1 bytes unless keys are equal)
+    for a, b in zip(order_by_bytes, order_by_code):
+        assert ks[a] == ks[b]
+
+
+def test_truncation_is_conservative():
+    # beyond width-1 bytes two distinct keys may collapse — but only to equal
+    a = b"p" * 40 + b"a"
+    b = b"p" * 40 + b"b"
+    ca, cb = K.encode_keys([a, b], width=32)
+    assert _cmp_codes(ca, cb) == 0
+
+
+def test_truncation_never_reorders():
+    # Different-length long keys sharing a truncated prefix must collapse to
+    # EQUAL codes, never invert (b"p"*31+b"z" > b"p"*31+b"aa" in byte order,
+    # and an unclamped trailing length byte would have reordered them).
+    a = b"p" * 31 + b"z"
+    b = b"p" * 31 + b"aa"
+    ca, cb = K.encode_keys([a, b], width=32)
+    assert _cmp_codes(ca, cb) == 0
+    # and any long key collapses to exactly its width-1-byte prefix's code
+    prefix = b"p" * 31
+    cp, cl = K.encode_keys([prefix, prefix + b"qqq"], width=32)
+    assert _cmp_codes(cp, cl) == 0
+
+
+def test_sentinel_is_max():
+    s = K.max_sentinel(32)
+    codes = K.encode_keys([b"\xff" * 31, b"zzz"], width=32)
+    assert _cmp_codes(codes[0], s) == -1
+    assert _cmp_codes(codes[1], s) == -1
+
+
+def test_lane_packing_big_endian():
+    c = K.encode_key(b"\x01\x02\x03\x04", width=8)
+    assert c.dtype == np.uint32
+    assert c[0] == 0x01020304
+    assert c[1] == 0x00000004  # length byte in last position
