@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""North-star benchmark: resolver conflict-check throughput, TPU vs native.
+
+Workload mirrors the reference's skip-list microbench (fdbserver/SkipList.cpp
+skipListTest, -r skiplisttest: batches of transactions with 1 read + 1 write
+range each, narrow ranges over a uniform keyspace, a sliding ~50-batch MVCC
+window), at the BASELINE.json north-star configuration (1M-key
+high-contention keyspace).
+
+Both backends resolve the *same* pre-encoded batches; verdict sequences must
+match exactly (identical abort rate — the north-star's fairness clause).
+Timed region covers resolution only, matching the reference's "Detect only"
+metric; the TPU side pipelines groups of batches through one lax.scan
+dispatch per group (resolve_many), the production shape of the resolver.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tpu txn/s, "unit": "txn/s",
+   "vs_baseline": tpu/native ratio}
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+BATCHES = int(os.environ.get("BENCH_BATCHES", "200"))
+TXNS = int(os.environ.get("BENCH_TXNS", "2500"))
+KEYSPACE = int(os.environ.get("BENCH_KEYSPACE", "1000000"))
+WINDOW = 50
+GROUP = int(os.environ.get("BENCH_GROUP", "20"))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_batches(n_batches, n_txns, seed=0):
+    from foundationdb_tpu.conflict.api import CommitTransaction
+
+    rnd = random.Random(seed)
+    batches = []
+    for i in range(n_batches):
+        txs = []
+        for _ in range(n_txns):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(
+                CommitTransaction(
+                    read_snapshot=i,
+                    read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                    write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)],
+                )
+            )
+        batches.append(txs)
+    return batches
+
+
+def main():
+    from foundationdb_tpu.conflict.native import NativeConflictSet
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+    log(f"generating {BATCHES} batches x {TXNS} txns over {KEYSPACE} keys")
+    batches = make_batches(BATCHES, TXNS)
+
+    # ---- native CPU baseline (the versioned skip list) ----
+    nat = NativeConflictSet()
+    nat_enc = [nat.encode_batch(txs) for txs in batches]
+    t0 = time.time()
+    nat_verdicts = []
+    for i, enc in enumerate(nat_enc):
+        nat_verdicts.append(nat.resolve_encoded(enc, i + WINDOW, i))
+    nat_dt = time.time() - t0
+    nat_tps = BATCHES * TXNS / nat_dt
+    aborts = sum(int((v != 0).sum()) for v in nat_verdicts)
+    log(
+        f"native skiplist: {nat_dt:.2f}s, {nat_tps/1e6:.3f} Mtxn/s, "
+        f"abort rate {aborts/(BATCHES*TXNS):.4f}, "
+        f"boundaries {nat.boundary_count}"
+    )
+
+    # ---- TPU kernel ----
+    cap = 1 << 17
+    while cap < 4 * TXNS * WINDOW:
+        cap <<= 1
+    tpu = TpuConflictSet(capacity=cap)
+    tpu_enc = [tpu.encode(txs) for txs in batches]
+
+    # warmup/compile on a copy of the first group
+    warm = TpuConflictSet(capacity=cap)
+    warm_enc = [warm.encode(txs) for txs in batches[:GROUP]]
+    t0 = time.time()
+    warm.detect_many_encoded(
+        [(e, i + WINDOW, i) for i, e in enumerate(warm_enc)]
+    )
+    log(f"compile+warmup: {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    tpu_verdicts = []
+    for g in range(0, BATCHES, GROUP):
+        work = [
+            (tpu_enc[i], i + WINDOW, i) for i in range(g, min(g + GROUP, BATCHES))
+        ]
+        tpu_verdicts.extend(tpu.detect_many_encoded(work))
+    tpu_dt = time.time() - t0
+    tpu_tps = BATCHES * TXNS / tpu_dt
+    t_aborts = sum(sum(1 for v in vs if v != 0) for vs in tpu_verdicts)
+    log(
+        f"tpu kernel: {tpu_dt:.2f}s, {tpu_tps/1e6:.3f} Mtxn/s, "
+        f"abort rate {t_aborts/(BATCHES*TXNS):.4f}"
+    )
+
+    # ---- verdict parity (identical abort decisions) ----
+    mismatch = 0
+    for i in range(BATCHES):
+        nv = nat_verdicts[i]
+        tv = tpu_verdicts[i]
+        for t in range(TXNS):
+            if int(nv[t]) != int(tv[t]):
+                mismatch += 1
+    if mismatch:
+        log(f"WARNING: {mismatch} verdict mismatches vs native baseline")
+    else:
+        log("verdict parity: all batches identical to native baseline")
+
+    print(
+        json.dumps(
+            {
+                "metric": "resolver_conflict_check_throughput",
+                "value": round(tpu_tps, 1),
+                "unit": "txn/s",
+                "vs_baseline": round(tpu_tps / nat_tps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

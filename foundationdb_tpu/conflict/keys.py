@@ -16,11 +16,12 @@ Why this is order-preserving (keys of length <= width-1):
   In particular ``k`` < ``k + b"\\x00"`` survives encoding, which is what makes
   FoundationDB point-write ranges ``[k, k+\\x00)`` non-empty after encoding.
 
-Keys longer than width-1 bytes are truncated: two long keys sharing the first
-width-1 bytes encode equal, which can only *merge* distinct keys — a
-conservative approximation that may add false conflicts but never misses one.
-(Default width is 32 → exact for keys up to 31 bytes; the reference's own
-benchmark keys — benchmarking.rst:22 — are 16 bytes.)
+Keys longer than width-1 bytes are truncated: range begins round down and
+range ends round up (``round_up=True``), so truncation can only *widen*
+ranges and *merge* distinct keys — a conservative approximation that may add
+false conflicts but never misses one. (Default width is 32 → exact for keys
+up to 31 bytes; the reference's own benchmark keys — benchmarking.rst:22 —
+are 16 bytes.)
 
 Device layout: each code is ``width // 4`` big-endian uint32 lanes, so
 lexicographic byte order == lexicographic lane order, and an N-key index is a
@@ -45,8 +46,18 @@ def encode_key(key: bytes, width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
     return encode_keys([key], width)[0]
 
 
-def encode_keys(keys: list[bytes], width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
-    """Encode a batch of keys → uint32[len(keys), width//4], order-preserving."""
+def encode_keys(
+    keys: list[bytes], width: int = DEFAULT_KEY_WIDTH, round_up: bool = False
+) -> np.ndarray:
+    """Encode a batch of keys → uint32[len(keys), width//4], order-preserving.
+
+    ``round_up=False`` rounds truncated keys DOWN (codes the width-1-byte
+    prefix); ``round_up=True`` rounds them UP (strictly above every key
+    sharing the truncated prefix, still below any larger prefix). Range
+    endpoints must use round-down for begins and round-up for ends so a
+    truncated range can only GROW (conservative: may add false conflicts,
+    never drops a write — e.g. a point range on a 40-byte key must not
+    collapse to empty)."""
     lanes_for_width(width)  # validate
     n = len(keys)
     buf = np.zeros((n, width), dtype=np.uint8)
@@ -58,7 +69,10 @@ def encode_keys(keys: list[bytes], width: int = DEFAULT_KEY_WIDTH) -> np.ndarray
         # the same code as its width-1-byte prefix, so truncation can only
         # MERGE keys (conservative), never reorder them. (An unclamped length
         # would order b"p"*31+b"z" before the byte-wise-smaller b"p"*31+b"aa".)
-        buf[i, width - 1] = min(len(k), width - 1)
+        if round_up and len(k) > width - 1:
+            buf[i, width - 1] = 0xFF  # > any clamped length byte
+        else:
+            buf[i, width - 1] = min(len(k), width - 1)
     return pack_lanes(buf)
 
 

@@ -1,0 +1,127 @@
+"""ctypes binding for the native C++ versioned-skip-list conflict set.
+
+The CPU baseline for the north-star benchmark (BASELINE.json): the TPU
+kernel must beat this by >=10x on the high-contention workload. Built from
+foundationdb_tpu/native/skiplist_conflict.cpp (``make -C
+foundationdb_tpu/native``; auto-built on first use if g++ is available).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from .api import CommitTransaction, ConflictSet, Verdict
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libskiplist_conflict.so"))
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR), "-s"], check=True
+        )
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.csn_create.restype = ctypes.c_void_p
+    lib.csn_destroy.argtypes = [ctypes.c_void_p]
+    lib.csn_count.argtypes = [ctypes.c_void_p]
+    lib.csn_count.restype = ctypes.c_int64
+    lib.csn_set_oldest.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.csn_resolve.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,  # keys
+        np.ctypeslib.ndpointer(np.uint64),  # offsets
+        np.ctypeslib.ndpointer(np.int32),  # reads
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32),  # writes
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64),  # snapshots
+        ctypes.c_int32,
+        ctypes.c_int64,  # now
+        ctypes.c_int64,  # new_oldest
+        np.ctypeslib.ndpointer(np.uint8),  # verdicts out
+    ]
+    _lib = lib
+    return lib
+
+
+class NativeConflictSet(ConflictSet):
+    def __init__(self) -> None:
+        super().__init__()
+        self._lib = _load()
+        self._cs = self._lib.csn_create()
+
+    def __del__(self):
+        if getattr(self, "_cs", None):
+            self._lib.csn_destroy(self._cs)
+            self._cs = None
+
+    def clear(self, version: int) -> None:
+        self._lib.csn_destroy(self._cs)
+        self._cs = self._lib.csn_create()
+        self._lib.csn_set_oldest(self._cs, version)
+        self.oldest_version = version
+
+    @property
+    def boundary_count(self) -> int:
+        return self._lib.csn_count(self._cs)
+
+    def encode_batch(self, transactions: list[CommitTransaction]):
+        """Pack a batch into the flat C ABI arrays (reusable across calls)."""
+        keys: list[bytes] = []
+        reads: list[int] = []
+        writes: list[int] = []
+        snaps = np.zeros(max(len(transactions), 1), np.int64)
+
+        def add_key(k: bytes) -> int:
+            keys.append(k)
+            return len(keys) - 1
+
+        for t, tr in enumerate(transactions):
+            snaps[t] = tr.read_snapshot
+            for (b, e) in tr.read_conflict_ranges:
+                reads.extend((add_key(b), add_key(e), t))
+            for (b, e) in tr.write_conflict_ranges:
+                writes.extend((add_key(b), add_key(e), t))
+
+        blob = b"".join(keys)
+        offsets = np.zeros(len(keys) + 1, np.uint64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        r = np.asarray(reads or [0], np.int32)
+        w = np.asarray(writes or [0], np.int32)
+        return (
+            blob,
+            offsets,
+            r,
+            len(reads) // 3,
+            w,
+            len(writes) // 3,
+            snaps,
+            len(transactions),
+        )
+
+    def resolve_encoded(self, enc, now: int, new_oldest_version: int) -> np.ndarray:
+        blob, offsets, r, nr, w, nw, snaps, nt = enc
+        verdicts = np.zeros(max(nt, 1), np.uint8)
+        self._lib.csn_resolve(
+            self._cs, blob, offsets, r, nr, w, nw, snaps, nt,
+            now, new_oldest_version, verdicts,
+        )
+        if new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+        return verdicts[:nt]
+
+    def detect_batch(
+        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
+    ) -> list[Verdict]:
+        enc = self.encode_batch(transactions)
+        out = self.resolve_encoded(enc, now, new_oldest_version)
+        return [Verdict(int(v)) for v in out]

@@ -1,0 +1,142 @@
+"""Multi-resolver conflict detection sharded over a TPU device mesh.
+
+The reference scales conflict resolution by key-range partitioning across
+resolver processes (keyResolvers map + ResolutionRequestBuilder,
+MasterProxyServer.actor.cpp:233-311; dynamic rebalancing
+masterserver.actor.cpp:896), with the proxy combining per-resolver verdicts
+by min — conflict dominates (MasterProxyServer.actor.cpp:482-489).
+
+The TPU-native equivalent maps that axis onto the device mesh:
+
+- mesh axis ``part``: each device (group) owns one key-range partition of the
+  versioned write-range index (an independent IndexState shard). Every
+  transaction's conflict ranges are *clipped* to the partition, resolved
+  locally, and verdicts are max-combined across ``part`` (COMMITTED=0 <
+  CONFLICT=1 < TOO_OLD=2, so max == "conflict dominates").
+- mesh axis ``data``: read ranges within a partition are data-parallel for
+  the history check and the intra-batch overlap matrix; partial results
+  combine with a psum/pmax over ``data``.
+
+Faithful to the reference's semantics including its documented relaxation:
+resolvers are independent, so a transaction aborted by partition A still has
+its writes merged by partition B (the reference has exactly this behavior —
+each resolver only knows its own key ranges).
+
+Collectives ride the ICI mesh; no host round-trips inside a batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import tpu_index as TI
+
+
+def make_sharded_states(n_parts: int, capacity: int, lanes: int) -> TI.IndexState:
+    """Stack of per-partition index states with leading axis [n_parts]."""
+    states = [TI.make_state(capacity, lanes) for _ in range(n_parts)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def _partition_bounds(lanes: int, n_parts: int, idx):
+    """Key-code range [plo, phi) owned by partition ``idx``: uniform split of
+    the first uint32 lane (dynamic resplitting by sampled load — the analog
+    of ResolutionSplitRequest — can replace this policy later)."""
+    step = jnp.uint32((1 << 32) // n_parts)
+    lo0 = step * idx.astype(jnp.uint32)
+    plo = jnp.zeros((lanes,), jnp.uint32).at[0].set(lo0)
+    is_last = idx == n_parts - 1
+    hi0 = jnp.where(is_last, jnp.uint32(0xFFFFFFFF), lo0 + step)
+    phi = jnp.where(
+        is_last,
+        jnp.full((lanes,), 0xFFFFFFFF, jnp.uint32),
+        jnp.zeros((lanes,), jnp.uint32).at[0].set(hi0),
+    )
+    return plo, phi
+
+
+def _lex_clip(b, e, plo, phi):
+    """Intersect ranges [b, e) with the partition [plo, phi)."""
+    b2 = jnp.where(TI.lex_lt(b, plo[None, :])[:, None], plo[None, :], b)
+    e2 = jnp.where(TI.lex_lt(phi[None, :], e)[:, None], phi[None, :], e)
+    return b2, e2
+
+
+def build_sharded_resolver(mesh: Mesh, num_txns: int, lanes: int):
+    """Returns a jitted fn(states, batch, now, oldest_pre, oldest_post) ->
+    (states, verdicts, needed) running one commit batch across the mesh.
+
+    ``states`` leading axis is sharded over ``part``; the batch's read arrays
+    are sharded over ``data`` (axis 0); everything else is replicated.
+    ``needed`` is int32[n_parts]: each partition's post-merge boundary count —
+    the host watches it to grow capacity / trigger dynamic re-splitting (the
+    analog of ResolutionSplitRequest, Resolver.actor.cpp:279).
+    """
+    n_parts = mesh.shape["part"]
+
+    def local_step(state_stk, batch: TI.Batch, now, oldest_pre, oldest_post):
+        # state_stk: this partition's IndexState with leading axis 1
+        state = jax.tree.map(lambda x: x[0], state_stk)
+        pidx = jax.lax.axis_index("part")
+        plo, phi = _partition_bounds(lanes, n_parts, pidx)
+
+        rb, re = _lex_clip(batch.rb, batch.re, plo, phi)
+        wb, we = _lex_clip(batch.wb, batch.we, plo, phi)
+        local_batch = TI.Batch(
+            rb=rb, re=re, r_snap=batch.r_snap, r_owner=batch.r_owner,
+            wb=wb, we=we, w_owner=batch.w_owner,
+            t_snap=batch.t_snap, t_has_reads=batch.t_has_reads,
+        )
+
+        too_old = batch.t_has_reads & (batch.t_snap < oldest_pre)
+
+        # History check: reads are sharded over 'data'; combine per-txn hits.
+        H_local = TI.history_conflicts(state, local_batch, num_txns)
+        H = jax.lax.pmax(H_local.astype(jnp.int32), "data").astype(bool)
+        H = H | too_old
+
+        # Intra-batch: shared kernel, with the T×T overlap matrix pmax-combined
+        # across the data shards before the greedy fixpoint.
+        commit = TI.intra_batch_commits(
+            local_batch,
+            H,
+            num_txns,
+            combine_pji=lambda p: jax.lax.pmax(p.astype(jnp.int32), "data").astype(
+                bool
+            ),
+        )
+
+        # Merge commits into this partition's shard (writes are replicated
+        # along 'data', so every data-row computes the same new state).
+        new_state, needed = TI.merge_writes(
+            state, local_batch, commit, now, oldest_post
+        )
+
+        verdict = jnp.where(
+            too_old,
+            jnp.int8(TI.TOO_OLD),
+            jnp.where(commit, jnp.int8(TI.COMMITTED), jnp.int8(TI.CONFLICT)),
+        )
+        verdict = jax.lax.pmax(verdict, "part")
+        verdict = jax.lax.pmax(verdict, "data")
+        return (
+            jax.tree.map(lambda x: x[None], new_state),
+            verdict,
+            needed[None],
+        )
+
+    state_spec = jax.tree.map(lambda _: P("part"), TI.IndexState(0, 0, 0, 0))
+    batch_spec = TI.Batch(
+        rb=P("data"), re=P("data"), r_snap=P("data"), r_owner=P("data"),
+        wb=P(), we=P(), w_owner=P(), t_snap=P(), t_has_reads=P(),
+    )
+    shard_fn = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, batch_spec, P(), P(), P()),
+        out_specs=(state_spec, P(), P("part")),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)

@@ -1,0 +1,282 @@
+"""Host glue for the TPU conflict-detection kernel.
+
+``TpuConflictSet`` implements the ConflictSet interface (conflict/api.py) on
+top of the functional device index in tpu_index.py:
+
+- encodes byte-string conflict ranges to fixed-width lane codes
+  (conflict/keys.py), padding batches to power-of-two buckets so jit
+  specializations stay bounded;
+- tracks the int64→int32 version rebasing origin (device versions are
+  offsets; the host rebases when the offset approaches int32 range);
+- pre-grows index capacity before a batch could overflow it (merged boundary
+  count is at most n + 2·writes, so growth never needs a device round-trip
+  retry);
+- converts device verdicts back to the API's Verdict enum.
+
+The same class runs unmodified on CPU (JAX_PLATFORMS=cpu) — that is the
+deterministic simulation twin the test suite uses, mirroring how the
+reference runs its resolver under deterministic simulation (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import keys as K
+from . import tpu_index as TI
+from .api import CommitTransaction, ConflictSet, Verdict
+
+_INT32_REBASE_THRESHOLD = 1 << 30
+
+
+def _bucket(n: int, floor: int = 32) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class TpuConflictSet(ConflictSet):
+    def __init__(self, key_width: int = K.DEFAULT_KEY_WIDTH, capacity: int = 1 << 14):
+        super().__init__()
+        self._width = key_width
+        self._lanes = K.lanes_for_width(key_width)
+        self._capacity = capacity
+        self._state = TI.make_state(capacity, self._lanes)
+        # Conservative host-side bound on the device boundary count (reading
+        # state.n would force a device sync per batch). n only grows by at
+        # most 2·writes per batch and GC only shrinks it.
+        self._n_bound = 1
+        # Device versions are stored as (version - base); base starts at -1 so
+        # every live version maps to >= 1 (0 means "never written").
+        self._base = -1
+        self._base_epoch = 0
+
+    # -- ConflictSet interface ------------------------------------------------
+
+    def clear(self, version: int) -> None:
+        self._state = TI.make_state(self._capacity, self._lanes)
+        self._n_bound = 1
+        self._base = version - 1
+        self._base_epoch += 1
+        self.oldest_version = version
+
+    def detect_batch(
+        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
+    ) -> list[Verdict]:
+        return self.detect_batch_async(transactions, now, new_oldest_version)()
+
+    def detect_batch_async(
+        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
+    ):
+        """Dispatch one batch without waiting for the device; returns a
+        zero-arg callable yielding the verdict list.
+
+        Under the axon tunnel a host↔device round trip costs ~70ms, so the
+        resolver pipelines: dispatch batch k+1 while k's verdicts are still
+        in flight (the reference's phase-gated batch pipelining,
+        MasterProxyServer.actor.cpp:353)."""
+        self._maybe_rebase(now)  # before encoding: snapshots are base-relative
+        batch, num_txns = self._encode(transactions)
+        self._ensure_capacity(2 * int(batch.wb.shape[0]))
+
+        # TOO_OLD gates on the pre-batch horizon; GC applies the post-batch
+        # horizon — matching the reference's ordering (addTransaction checks
+        # cs->oldestVersion, SkipList.cpp:989; removeBefore at :1195).
+        horizon = max(self.oldest_version, new_oldest_version)
+        state, verdicts, _needed = TI.resolve_batch(
+            self._state,
+            batch,
+            np.int32(now - self._base),
+            np.int32(max(self.oldest_version - self._base, 0)),
+            np.int32(max(horizon - self._base, 0)),
+            num_txns,
+        )
+        self._state = state
+        self._n_bound = min(
+            self._n_bound + 2 * int(batch.wb.shape[0]), self._capacity
+        )
+        self.oldest_version = horizon
+        n = len(transactions)
+
+        def result(verdicts=verdicts, n=n):
+            out = np.asarray(verdicts[:n])
+            return [Verdict(int(v)) for v in out]
+
+        return result
+
+    def detect_many(
+        self, work: list[tuple[list[CommitTransaction], int, int]]
+    ) -> list[list[Verdict]]:
+        """Resolve many (transactions, now, new_oldest) batches in one device
+        dispatch via lax.scan (TI.resolve_many). All batches are padded to
+        shared bucket shapes."""
+        if not work:
+            return []
+        self._maybe_rebase(max(now for _, now, _2 in work))
+        return self.detect_many_encoded(
+            [(self.encode(txs), now, old) for txs, now, old in work]
+        )
+
+    def encode(self, transactions: list[CommitTransaction]):
+        """Pre-encode a batch for detect_many_encoded. Encodings are
+        horizon-independent but base-relative: a version rebase invalidates
+        them (guarded via the epoch stamp)."""
+        b, T = self._encode(transactions)
+        return b, T, len(transactions), self._base_epoch
+
+    def detect_many_encoded(self, work) -> list[list[Verdict]]:
+        """work: list of (encoded, now, new_oldest), encoded from encode()."""
+        if not work:
+            return []
+        encoded = []
+        counts = []
+        for (b, T, n_real, epoch), now, new_oldest in work:
+            if epoch != self._base_epoch:
+                raise RuntimeError(
+                    "stale encoding: version base was rebased after encode()"
+                )
+            old_pre = self.oldest_version
+            horizon = max(self.oldest_version, new_oldest)
+            encoded.append((b, T, now, old_pre, horizon))
+            counts.append(n_real)
+            self.oldest_version = horizon
+        return self._detect_encoded(encoded, counts)
+
+    def _detect_encoded(self, encoded, counts) -> list[list[Verdict]]:
+        self._ensure_capacity(sum(2 * int(b.wb.shape[0]) for b, *_ in encoded))
+
+        # Re-pad every batch to the group-max bucket shapes and stack.
+        Tm = max(T for _, T, *_ in encoded)
+        Rm = max(int(b.rb.shape[0]) for b, *_ in encoded)
+        Wm = max(int(b.wb.shape[0]) for b, *_ in encoded)
+        stacked = TI.Batch(
+            rb=np.stack([self._pad2(b.rb, Rm) for b, *_ in encoded]),
+            re=np.stack([self._pad2(b.re, Rm) for b, *_ in encoded]),
+            r_snap=np.stack([self._pad1(b.r_snap, Rm) for b, *_ in encoded]),
+            r_owner=np.stack([self._pad1(b.r_owner, Rm) for b, *_ in encoded]),
+            wb=np.stack([self._pad2(b.wb, Wm) for b, *_ in encoded]),
+            we=np.stack([self._pad2(b.we, Wm) for b, *_ in encoded]),
+            w_owner=np.stack([self._pad1(b.w_owner, Wm) for b, *_ in encoded]),
+            t_snap=np.stack([self._pad1(b.t_snap, Tm) for b, *_ in encoded]),
+            t_has_reads=np.stack(
+                [self._pad1(b.t_has_reads, Tm) for b, *_ in encoded]
+            ),
+        )
+        nows = np.asarray(
+            [now - self._base for _, _, now, *_ in encoded], np.int32
+        )
+        olds_pre = np.asarray(
+            [max(p - self._base, 0) for *_, p, _h in encoded], np.int32
+        )
+        olds_post = np.asarray(
+            [max(h - self._base, 0) for *_, h in encoded], np.int32
+        )
+        state, verdicts, _needed = TI.resolve_many(
+            self._state, stacked, nows, olds_pre, olds_post, Tm
+        )
+        self._state = state
+        for b, *_ in encoded:
+            self._n_bound = min(
+                self._n_bound + 2 * int(b.wb.shape[0]), self._capacity
+            )
+        out = np.asarray(verdicts)
+        return [
+            [Verdict(int(v)) for v in out[g, : counts[g]]]
+            for g in range(len(encoded))
+        ]
+
+    @staticmethod
+    def _pad2(a: np.ndarray, size: int) -> np.ndarray:
+        if a.shape[0] == size:
+            return a
+        out = np.full((size, a.shape[1]), 0xFFFFFFFF, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    @staticmethod
+    def _pad1(a: np.ndarray, size: int) -> np.ndarray:
+        if a.shape[0] == size:
+            return a
+        out = np.zeros((size,), dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    # -- internals ------------------------------------------------------------
+
+    def _encode(self, transactions):
+        """Encode a batch to device arrays. Horizon-independent: TOO_OLD is
+        determined on device from per-transaction snapshots, so pre-encoded
+        batches stay valid as the horizon advances. Only a version rebase
+        invalidates an encoding (checked via _base_epoch)."""
+        reads: list[tuple[bytes, bytes, int, int]] = []
+        writes: list[tuple[bytes, bytes, int]] = []
+        t_snap_l = []
+        t_has_reads_l = []
+        for t, tr in enumerate(transactions):
+            snap = max(tr.read_snapshot - self._base, 0)
+            t_snap_l.append(snap)
+            t_has_reads_l.append(bool(tr.read_conflict_ranges))
+            for (b, e) in tr.read_conflict_ranges:
+                reads.append((b, e, snap, t))
+            for (b, e) in tr.write_conflict_ranges:
+                writes.append((b, e, t))
+
+        T = _bucket(max(len(transactions), 1))
+        R = _bucket(max(len(reads), 1))
+        W = _bucket(max(len(writes), 1))
+        sent = K.max_sentinel(self._width)
+
+        def pad_codes(ks: list[bytes], size: int, round_up: bool) -> np.ndarray:
+            out = np.tile(sent, (size, 1))
+            if ks:
+                out[: len(ks)] = K.encode_keys(ks, self._width, round_up=round_up)
+            return out
+
+        # Range begins round down, ends round up: a truncated range can only
+        # widen (conflict/keys.py), never collapse to empty.
+        rb = pad_codes([r[0] for r in reads], R, False)
+        re = pad_codes([r[1] for r in reads], R, True)
+        # padded slots: rb == re == sentinel → inactive (rb >= re)
+        r_snap = np.zeros(R, np.int32)
+        r_snap[: len(reads)] = [r[2] for r in reads]
+        r_owner = np.zeros(R, np.int32)
+        r_owner[: len(reads)] = [r[3] for r in reads]
+
+        wb = pad_codes([w[0] for w in writes], W, False)
+        we = pad_codes([w[1] for w in writes], W, True)
+        w_owner = np.zeros(W, np.int32)
+        w_owner[: len(writes)] = [w[2] for w in writes]
+
+        t_snap = np.zeros(T, np.int32)
+        t_snap[: len(t_snap_l)] = t_snap_l
+        t_has_reads = np.zeros(T, bool)
+        t_has_reads[: len(t_has_reads_l)] = t_has_reads_l
+
+        batch = TI.Batch(
+            rb=rb, re=re, r_snap=r_snap, r_owner=r_owner,
+            wb=wb, we=we, w_owner=w_owner,
+            t_snap=t_snap, t_has_reads=t_has_reads,
+        )
+        return batch, T
+
+    def _maybe_rebase(self, now: int) -> None:
+        if now - self._base < _INT32_REBASE_THRESHOLD:
+            return
+        new_base = self.oldest_version - 1
+        delta = new_base - self._base
+        if delta > 0:
+            self._state = TI.rebase(self._state, np.int32(delta))
+            self._base = new_base
+            self._base_epoch += 1
+
+    def _ensure_capacity(self, extra: int) -> None:
+        # needed <= n + extra; grow until that fits (keeps resolve_*'s state
+        # donation safe — no retry path). Only when the conservative bound is
+        # tight do we pay one device sync to learn the true n.
+        if self._n_bound + extra <= self._capacity:
+            return
+        self._n_bound = max(int(self._state.n), 1)
+        while self._n_bound + extra > self._capacity:
+            self._capacity *= 2
+            self._state = TI.grow_state(self._state, self._capacity)

@@ -8,7 +8,9 @@ jax import, hence os.environ at module scope.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the shell environment points JAX at the axon TPU tunnel
+# (JAX_PLATFORMS=axon); tests must never touch the single shared chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +20,26 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import random
 
 import pytest
+
+
+def pytest_configure(config):
+    import jax
+
+    # The axon TPU plugin (registered by sitecustomize at interpreter start)
+    # hangs backend init whenever the tunnel relay is busy or wedged — and
+    # xla_bridge initializes every registered platform, not just the ones in
+    # JAX_PLATFORMS. Drop its factory so CPU tests can never touch it.
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    # A pytest entry-point plugin may have imported jax before this conftest,
+    # freezing jax_platforms from the original env — override via config.
+    jax.config.update("jax_platforms", "cpu")
+
+    # Persistent XLA compilation cache: this box has one CPU core, and cold
+    # compiles dominate test time otherwise.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture

@@ -1,0 +1,178 @@
+"""Differential tests: TPU conflict kernel vs the Python oracle.
+
+The reference validates its skip list against SlowConflictSet
+(SkipList.cpp:59-88) and with the oracle-checked ConflictRange workload
+(fdbserver/workloads/ConflictRange.actor.cpp); this is the same strategy —
+randomized batches must produce byte-identical verdict sequences.
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.conflict.api import CommitTransaction, Verdict, new_conflict_set
+
+
+def _random_range(rnd, keyspace):
+    a = rnd.randrange(keyspace)
+    b = a + 1 + rnd.randrange(10)
+    enc = lambda x: b"k%08d" % x
+    return (enc(a), enc(b))
+
+
+def _random_batch(rnd, keyspace, n_txns, snap_lo, snap_hi):
+    txs = []
+    for _ in range(n_txns):
+        tr = CommitTransaction(read_snapshot=rnd.randrange(snap_lo, snap_hi + 1))
+        for _ in range(rnd.randrange(0, 3)):
+            tr.read_conflict_ranges.append(_random_range(rnd, keyspace))
+        for _ in range(rnd.randrange(0, 3)):
+            tr.write_conflict_ranges.append(_random_range(rnd, keyspace))
+        txs.append(tr)
+    return txs
+
+
+def _run_differential(seed, batches, keyspace, n_txns, capacity=1 << 8):
+    rnd = random.Random(seed)
+    tpu = new_conflict_set("tpu", capacity=capacity)
+    oracle = new_conflict_set("oracle")
+    version = 100
+    for b in range(batches):
+        oldest = max(0, version - 40)  # sliding MVCC window
+        snap_lo = max(0, version - 60)  # sometimes below the horizon → TOO_OLD
+        txs = _random_batch(rnd, keyspace, n_txns, snap_lo, version)
+        vt = tpu.detect_batch(txs, version + 10, oldest)
+        vo = oracle.detect_batch(txs, version + 10, oldest)
+        assert vt == vo, f"batch {b} diverged: tpu={vt} oracle={vo}"
+        version += 10
+    # abort-rate sanity: contention must actually produce every verdict kind
+    return None
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_differential_high_contention(seed):
+    # tiny keyspace → heavy overlap, exercises history + intra-batch + GC
+    _run_differential(seed, batches=25, keyspace=30, n_txns=12)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_differential_low_contention(seed):
+    _run_differential(seed + 100, batches=10, keyspace=100000, n_txns=16)
+
+
+def test_differential_growth_from_tiny_capacity():
+    # capacity 16 forces repeated index growth mid-run
+    _run_differential(7, batches=20, keyspace=500, n_txns=10, capacity=16)
+
+
+def test_point_and_edge_semantics_match_oracle():
+    tpu = new_conflict_set("tpu", capacity=1 << 6)
+    oracle = new_conflict_set("oracle")
+
+    def both(txs, now, oldest):
+        a = tpu.detect_batch(txs, now, oldest)
+        b = oracle.detect_batch(txs, now, oldest)
+        assert a == b, (a, b)
+        return a
+
+    t0 = CommitTransaction(0, [], [(b"k", b"k\x00")])
+    assert both([t0], 10, 0) == [Verdict.COMMITTED]
+    # exact point read of the written key vs adjacent point
+    r_hit = CommitTransaction(5, [(b"k", b"k\x00")], [])
+    r_miss = CommitTransaction(5, [(b"k\x00", b"k\x00\x00")], [])
+    assert both([r_hit, r_miss], 11, 0) == [Verdict.CONFLICT, Verdict.COMMITTED]
+    # empty ranges are no-ops
+    weird = CommitTransaction(5, [(b"z", b"a")], [(b"q", b"q")])
+    assert both([weird], 12, 0) == [Verdict.COMMITTED]
+    # intra-batch chain: w(a), r(a)+w(b), r(b) → C, X, C
+    c0 = CommitTransaction(12, [], [(b"a", b"b")])
+    c1 = CommitTransaction(12, [(b"a", b"b")], [(b"b", b"c")])
+    c2 = CommitTransaction(12, [(b"b", b"c")], [])
+    assert both([c0, c1, c2], 13, 0) == [
+        Verdict.COMMITTED,
+        Verdict.CONFLICT,
+        Verdict.COMMITTED,
+    ]
+
+
+def test_clear_resets_history():
+    tpu = new_conflict_set("tpu", capacity=1 << 6)
+    tpu.detect_batch([CommitTransaction(0, [], [(b"a", b"b")])], 10, 0)
+    tpu.clear(20)
+    out = tpu.detect_batch([CommitTransaction(25, [(b"a", b"b")], [])], 30, 20)
+    assert out == [Verdict.COMMITTED]
+
+
+def test_detect_many_matches_sequential():
+    # the scanned multi-batch path must agree with batch-at-a-time resolution
+    rnd = random.Random(11)
+    seq = new_conflict_set("tpu", capacity=1 << 8)
+    piped = new_conflict_set("tpu", capacity=1 << 8)
+    version = 100
+    work = []
+    expected = []
+    for b in range(8):
+        oldest = max(0, version - 40)
+        txs = _random_batch(rnd, 40, 10, max(0, version - 60), version)
+        work.append((txs, version + 10, oldest))
+        expected.append(seq.detect_batch(txs, version + 10, oldest))
+        version += 10
+    got = piped.detect_many(work)
+    assert got == expected
+
+
+def test_native_backend_matches_oracle():
+    pytest.importorskip("ctypes")
+    rnd = random.Random(5)
+    nat = new_conflict_set("native")
+    orc = new_conflict_set("oracle")
+    version = 100
+    for b in range(20):
+        oldest = max(0, version - 40)
+        txs = _random_batch(rnd, 30, 12, max(0, version - 60), version)
+        vn = nat.detect_batch(txs, version + 10, oldest)
+        vo = orc.detect_batch(txs, version + 10, oldest)
+        assert vn == vo, f"batch {b}: {vn} vs {vo}"
+        version += 10
+
+
+def test_long_key_point_write_not_dropped():
+    # Keys beyond width-1 bytes: the encoded range must widen, never collapse
+    # to empty — a dropped write would be a missed conflict (serializability
+    # violation). Conservative false conflicts are acceptable here.
+    k = b"p" * 40  # longer than the 31-byte exact window
+    tpu = new_conflict_set("tpu", capacity=1 << 6)
+    tpu.detect_batch([CommitTransaction(0, [], [(k, k + b"\x00")])], 10, 0)
+    out = tpu.detect_batch([CommitTransaction(5, [(k, k + b"\x00")], [])], 11, 0)
+    assert out == [Verdict.CONFLICT]
+
+
+def test_native_clear_preserves_horizon():
+    nat = new_conflict_set("native")
+    nat.clear(20)
+    out = nat.detect_batch([CommitTransaction(5, [(b"a", b"b")], [])], 30, 20)
+    assert out == [Verdict.TOO_OLD]
+
+
+def test_pre_encoded_too_old_tracks_horizon():
+    # TOO_OLD must be decided at resolve time (device-side), not encode time.
+    tpu = new_conflict_set("tpu", capacity=1 << 8)
+    stale = tpu.encode([CommitTransaction(5, [(b"a", b"b")], [])])
+    filler = tpu.encode([CommitTransaction(55, [], [(b"x", b"y")])])
+    outs = tpu.detect_many_encoded([(filler, 60, 50), (stale, 100, 50)])
+    assert outs[1] == [Verdict.TOO_OLD]
+
+
+def test_verdict_mix_under_contention():
+    # ensure the differential workloads actually exercise all verdicts
+    rnd = random.Random(3)
+    tpu = new_conflict_set("tpu", capacity=1 << 8)
+    seen = set()
+    version = 100
+    for b in range(30):
+        oldest = max(0, version - 40)
+        txs = _random_batch(rnd, 30, 12, max(0, version - 60), version)
+        for v in tpu.detect_batch(txs, version + 10, oldest):
+            seen.add(v)
+        version += 10
+    assert seen == {Verdict.COMMITTED, Verdict.CONFLICT, Verdict.TOO_OLD}
