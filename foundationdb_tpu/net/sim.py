@@ -1,0 +1,205 @@
+"""Deterministic cluster simulation: processes, network, faults.
+
+The analog of the reference's Sim2 (fdbrpc/sim2.actor.cpp:720 — virtual
+time, per-process scheduling, connection clogging/latency, kill/reboot) built
+on the runtime event loop. Every "process" is a container of actors with an
+address; messages between processes are scheduled with seeded random latency;
+fault APIs mirror ISimulator (fdbrpc/simulator.h:148-155,263):
+
+  clog_pair(a, b, secs)   — delay all a→b traffic
+  partition(a, b)/heal()  — drop a↔b traffic
+  kill_process / reboot   — cancel all actors of a process (optionally
+                            rerunning its boot function)
+
+Determinism: latency and loss draw from the loop's DeterministicRandom; a
+whole cluster run replays bit-identically from its seed (§4 of SURVEY.md —
+the primary correctness strategy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
+from ..runtime.knobs import Knobs
+from ..runtime.loop import EventLoop, TaskPriority, set_loop
+from ..runtime.trace import SevInfo, SevWarn, trace
+
+
+class BrokenPromise(Exception):
+    """Request to a dead/unknown endpoint (flow's broken_promise)."""
+
+
+class Endpoint:
+    """(process address, token) — fdbrpc/FlowTransport.h:28-49."""
+
+    __slots__ = ("address", "token")
+
+    def __init__(self, address: str, token: str):
+        self.address = address
+        self.token = token
+
+    def __repr__(self):
+        return f"{self.address}:{self.token}"
+
+
+class SimProcess:
+    def __init__(self, sim: "Sim", address: str, machine: str, boot=None):
+        self.sim = sim
+        self.address = address
+        self.machine = machine
+        self.boot = boot  # async fn(process) rerun on reboot
+        self.endpoints: dict[str, Callable] = {}  # token → async handler
+        self.actors = ActorCollection()
+        self.alive = True
+        self.reboots = 0
+
+    def register(self, token: str, handler: Callable) -> Endpoint:
+        self.endpoints[token] = handler
+        return Endpoint(self.address, token)
+
+    def spawn(self, coro, priority: int = TaskPriority.DEFAULT) -> Future:
+        fut = spawn(coro, priority)
+        self.actors.add(fut)
+        return fut
+
+
+class Sim:
+    """One simulated cluster world bound to one event loop."""
+
+    def __init__(self, seed: int = 0, knobs: Optional[Knobs] = None):
+        self.loop = EventLoop(seed)
+        self.knobs = knobs or Knobs()
+        self.processes: dict[str, SimProcess] = {}
+        self._clogged_until: dict[tuple[str, str], float] = {}
+        self._partitioned: set[tuple[str, str]] = set()
+
+    # -- world construction ---------------------------------------------------
+
+    def new_process(self, address: str, machine: str = None, boot=None) -> SimProcess:
+        p = SimProcess(self, address, machine or address, boot)
+        self.processes[address] = p
+        if boot is not None:
+            p.spawn(boot(p))
+        return p
+
+    # -- messaging ------------------------------------------------------------
+
+    def _latency(self) -> float:
+        k = self.knobs
+        return k.SIM_MIN_LATENCY + self.loop.random.random01() * (
+            k.SIM_MAX_LATENCY - k.SIM_MIN_LATENCY
+        )
+
+    def _deliverable(self, src: str, dst: str) -> bool:
+        return (src, dst) not in self._partitioned and (
+            dst,
+            src,
+        ) not in self._partitioned
+
+    def _delivery_time(self, src: str, dst: str) -> float:
+        t = self.loop.now() + self._latency()
+        clog = self._clogged_until.get((src, dst), 0.0)
+        return max(t, clog)
+
+    def request(self, src: str, ep: Endpoint, payload: Any) -> Future:
+        """One RPC: request and reply each traverse the simulated network.
+        The reply future errors with BrokenPromise if the destination is dead
+        or unreachable — callers retry exactly like the reference's clients."""
+        reply: Future = Future()
+
+        def deliver():
+            dst = self.processes.get(ep.address)
+            if dst is None or not dst.alive or ep.token not in dst.endpoints:
+                self._reply_err(src, ep.address, reply, BrokenPromise(str(ep)))
+                return
+            handler = dst.endpoints[ep.token]
+
+            async def run_and_reply():
+                try:
+                    # the handler itself is owned by the destination process,
+                    # so kill_process cancels it mid-flight
+                    result = await dst.spawn(handler(payload))
+                except Cancelled:
+                    self._reply_err(ep.address, src, reply, BrokenPromise(str(ep)))
+                    return
+                except BaseException as e:
+                    self._reply_err(ep.address, src, reply, e)
+                    return
+                self._reply_ok(ep.address, src, reply, result)
+
+            dst.spawn(run_and_reply())
+
+        if not self._deliverable(src, ep.address):
+            # dropped on the floor: the caller's timeout/failure monitor acts
+            return reply
+        self.loop.call_at(self._delivery_time(src, ep.address), deliver)
+        return reply
+
+    def _reply_ok(self, src: str, dst: str, reply: Future, value) -> None:
+        if not self._deliverable(src, dst):
+            return
+        self.loop.call_at(self._delivery_time(src, dst), lambda: reply._set(value))
+
+    def _reply_err(self, src: str, dst: str, reply: Future, err) -> None:
+        if not self._deliverable(src, dst):
+            return
+        self.loop.call_at(
+            self._delivery_time(src, dst), lambda: reply._set_error(err)
+        )
+
+    # -- fault injection (ISimulator analog) ----------------------------------
+
+    def clog_pair(self, a: str, b: str, seconds: float) -> None:
+        until = self.loop.now() + seconds
+        self._clogged_until[(a, b)] = max(self._clogged_until.get((a, b), 0), until)
+        self._clogged_until[(b, a)] = max(self._clogged_until.get((b, a), 0), until)
+        trace(SevInfo, "Clog", "sim", A=a, B=b, Until=until)
+
+    def partition(self, a: str, b: str) -> None:
+        self._partitioned.add((a, b))
+        trace(SevWarn, "Partition", "sim", A=a, B=b)
+
+    def heal(self, a: str = None, b: str = None) -> None:
+        if a is None:
+            self._partitioned.clear()
+        else:
+            self._partitioned.discard((a, b))
+            self._partitioned.discard((b, a))
+
+    def kill_process(self, address: str, reboot_in: Optional[float] = None) -> None:
+        p = self.processes.get(address)
+        if p is None or not p.alive:
+            return
+        trace(SevWarn, "KillProcess", address, RebootIn=reboot_in)
+        p.alive = False
+        p.actors.cancel_all()
+        p.endpoints.clear()
+        if reboot_in is not None and p.boot is not None:
+            self.loop.call_at(self.loop.now() + reboot_in, lambda: self.reboot(address))
+
+    def reboot(self, address: str) -> None:
+        p = self.processes.get(address)
+        if p is None or p.alive:
+            return
+        trace(SevInfo, "RebootProcess", address)
+        p.alive = True
+        p.reboots += 1
+        p.actors = ActorCollection()
+        p.spawn(p.boot(p))
+
+    # -- running --------------------------------------------------------------
+
+    def activate(self) -> None:
+        set_loop(self.loop)
+
+    def run(self, until: float = float("inf"), stop_when=None) -> float:
+        self.activate()
+        return self.loop.run(until, stop_when)
+
+    def run_until_done(self, fut: Future, limit: float = 1e9) -> Any:
+        self.activate()
+        self.loop.run(until=limit, stop_when=fut.is_ready)
+        if not fut.is_ready():
+            raise TimeoutError(f"simulation did not finish by t={limit}")
+        return fut.get()

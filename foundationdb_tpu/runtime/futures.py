@@ -1,0 +1,328 @@
+"""Futures, promises, actors, streams, and combinators on the event loop.
+
+The analog of the reference's flow core (flow/flow.h:275-899 —
+SAV/Future/Promise/PromiseStream/NotifiedQueue) and its combinator library
+(flow/genericactors.actor.h). Python coroutines replace the C# actor
+compiler: ``async def`` bodies are the ``ACTOR`` functions, ``await`` is
+``wait()``, and ``spawn()`` drives them as cancellable tasks on the loop.
+
+Semantics mirrored from the reference:
+- ``Promise.send`` fires callbacks immediately-but-scheduled (delivery order
+  is loop order, deterministic);
+- dropping/cancelling an actor's future cancels the actor (Cancelled is
+  thrown at its current await point — flow's actor_cancelled);
+- ``PromiseStream`` is a multi-value channel; readers block on next().
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Awaitable, Callable, Generic, Optional, TypeVar
+
+from .loop import Cancelled, TaskPriority, current_loop
+
+T = TypeVar("T")
+
+
+class Future(Generic[T]):
+    __slots__ = ("_value", "_error", "_done", "_callbacks", "_task")
+
+    def __init__(self):
+        self._value: Optional[T] = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+        self._callbacks: list[Callable[[Future], None]] = []
+        self._task: Optional[Task] = None  # set when this is an actor's future
+
+    # -- inspection
+    def is_ready(self) -> bool:
+        return self._done
+
+    def is_error(self) -> bool:
+        return self._done and self._error is not None
+
+    def get(self) -> T:
+        assert self._done
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # -- completion
+    def _set(self, value: T) -> None:
+        if self._done:
+            return
+        self._value = value
+        self._done = True
+        self._fire()
+
+    def _set_error(self, err: BaseException) -> None:
+        if self._done:
+            return
+        self._error = err
+        self._done = True
+        self._fire()
+
+    def _fire(self) -> None:
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def add_callback(self, cb: Callable[["Future"], None]) -> None:
+        if self._done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def cancel(self) -> None:
+        """Cancel the actor producing this future (no-op if plain promise)."""
+        if self._task is not None and not self._done:
+            self._task.cancel()
+
+    # -- await protocol
+    def __await__(self):
+        if not self._done:
+            yield self
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class Promise(Generic[T]):
+    __slots__ = ("future",)
+
+    def __init__(self):
+        self.future: Future[T] = Future()
+
+    def send(self, value: T = None) -> None:
+        self.future._set(value)
+
+    def send_error(self, err: BaseException) -> None:
+        self.future._set_error(err)
+
+    def is_set(self) -> bool:
+        return self.future.is_ready()
+
+
+class Task:
+    """Drives a coroutine on the loop; the generated actor state machine."""
+
+    def __init__(self, coro, priority: int = TaskPriority.DEFAULT):
+        self.coro = coro
+        self.future: Future = Future()
+        self.future._task = self
+        self.priority = priority
+        self._cancelled = False
+        self._waiting_on: Optional[Future] = None
+
+    def start(self) -> Future:
+        current_loop().call_soon(lambda: self._step(None, None), self.priority)
+        return self.future
+
+    def cancel(self) -> None:
+        if self.future.is_ready() or self._cancelled:
+            return
+        self._cancelled = True
+        current_loop().call_soon(
+            lambda: self._step(None, Cancelled()), TaskPriority.MAX
+        )
+
+    def _step(self, value, error) -> None:
+        if self.future.is_ready():
+            return
+        self._waiting_on = None
+        try:
+            if error is not None:
+                awaited = self.coro.throw(error)
+            else:
+                awaited = self.coro.send(value)
+        except StopIteration as stop:
+            self.future._set(stop.value)
+            return
+        except Cancelled as c:
+            self.future._set_error(c)
+            return
+        except BaseException as e:
+            self.future._set_error(e)
+            return
+        # The coroutine yielded a Future it waits on.
+        assert isinstance(awaited, Future), f"actors must await Futures, got {awaited!r}"
+        self._waiting_on = awaited
+
+        def wake(f: Future, task=self):
+            if task._cancelled or task.future.is_ready():
+                return
+            if f._error is not None:
+                current_loop().call_soon(
+                    lambda: task._step(None, f._error), task.priority
+                )
+            else:
+                current_loop().call_soon(
+                    lambda: task._step(f._value, None), task.priority
+                )
+
+        awaited.add_callback(wake)
+
+
+def spawn(coro, priority: int = TaskPriority.DEFAULT) -> Future:
+    """Run an async def body as an actor; returns its future (cancellable)."""
+    return Task(coro, priority).start()
+
+
+# ---------------------------------------------------------------------------
+# Timers / yields
+
+
+def delay(seconds: float, priority: int = TaskPriority.DEFAULT) -> Future[None]:
+    f: Future[None] = Future()
+    current_loop().call_at(current_loop().now() + seconds, lambda: f._set(None), priority)
+    return f
+
+
+def yield_now(priority: int = TaskPriority.DEFAULT) -> Future[None]:
+    return delay(0.0, priority)
+
+
+async def forever():
+    await Future()  # never completes (until cancelled)
+
+
+# ---------------------------------------------------------------------------
+# Streams (PromiseStream / NotifiedQueue, flow/flow.h:504-899)
+
+
+class StreamClosed(Exception):
+    pass
+
+
+class PromiseStream(Generic[T]):
+    def __init__(self):
+        self._queue: deque[T] = deque()
+        self._waiters: deque[Future] = deque()
+        self._closed = False
+        self._close_error: Optional[BaseException] = None
+
+    def send(self, value: T) -> None:
+        if self._closed:
+            return
+        if self._waiters:
+            self._waiters.popleft()._set(value)
+        else:
+            self._queue.append(value)
+
+    def close(self, err: Optional[BaseException] = None) -> None:
+        self._closed = True
+        self._close_error = err or StreamClosed()
+        while self._waiters:
+            self._waiters.popleft()._set_error(self._close_error)
+
+    def next(self) -> Future[T]:
+        f: Future[T] = Future()
+        if self._queue:
+            f._set(self._queue.popleft())
+        elif self._closed:
+            f._set_error(self._close_error)
+        else:
+            self._waiters.append(f)
+        return f
+
+    def is_empty(self) -> bool:
+        return not self._queue
+
+
+# ---------------------------------------------------------------------------
+# Combinators (genericactors.actor.h analogs)
+
+
+async def wait_for_all(futures: list[Future]) -> list:
+    out = []
+    for f in futures:
+        out.append(await f)
+    return out
+
+
+def wait_for_any(futures: list[Future]) -> Future[int]:
+    """Resolves to the index of the first completed future."""
+    out: Future[int] = Future()
+
+    def make_cb(i):
+        def cb(f: Future):
+            if not out.is_ready():
+                if f._error is not None and not isinstance(f._error, Cancelled):
+                    out._set_error(f._error)
+                else:
+                    out._set(i)
+
+        return cb
+
+    for i, f in enumerate(futures):
+        f.add_callback(make_cb(i))
+    return out
+
+
+class TimedOut(Exception):
+    pass
+
+
+async def timeout(fut: Future[T], seconds: float, default=None) -> T:
+    timer = delay(seconds)
+    which = await wait_for_any([fut, timer])
+    if which == 0:
+        return fut.get()
+    fut.cancel()
+    return default
+
+
+class AsyncVar(Generic[T]):
+    """A variable whose changes can be awaited (flow's AsyncVar)."""
+
+    def __init__(self, value: T = None):
+        self._value = value
+        self._change: Future[None] = Future()
+
+    def get(self) -> T:
+        return self._value
+
+    def set(self, value: T) -> None:
+        if value != self._value:
+            self._value = value
+            old, self._change = self._change, Future()
+            old._set(None)
+
+    def on_change(self) -> Future[None]:
+        return self._change
+
+
+class AsyncTrigger:
+    def __init__(self):
+        self._f: Future[None] = Future()
+
+    def trigger(self) -> None:
+        old, self._f = self._f, Future()
+        old._set(None)
+
+    def on_trigger(self) -> Future[None]:
+        return self._f
+
+
+class ActorCollection:
+    """Holds actor futures; errors propagate, completions are discarded
+    (flow/ActorCollection.actor.cpp)."""
+
+    def __init__(self):
+        self._actors: list[Future] = []
+        self.error: Future = Future()
+
+    def add(self, fut: Future) -> None:
+        self._actors.append(fut)
+
+        def cb(f: Future):
+            if f._error is not None and not isinstance(f._error, Cancelled):
+                if not self.error.is_ready():
+                    self.error._set_error(f._error)
+
+        fut.add_callback(cb)
+
+    def cancel_all(self) -> None:
+        for f in self._actors:
+            f.cancel()
+        self._actors.clear()

@@ -1,0 +1,52 @@
+"""Config knobs — the analog of flow/Knobs.cpp (Flow/Client/Server knobs).
+
+Defaults live here; simulation randomizes a subset per run (the reference's
+BUGGIFY-aware knob randomization, SURVEY.md §5.6); anything can be overridden
+by name (the --knob_name flag path, fdbserver.actor.cpp:923).
+"""
+
+from __future__ import annotations
+
+
+class Knobs:
+    # commit pipeline
+    COMMIT_BATCH_INTERVAL = 0.002  # proxy batch window (s)
+    MAX_BATCH_TXNS = 4096
+    VERSIONS_PER_SECOND = 1_000_000
+    MAX_READ_TRANSACTION_LIFE_VERSIONS = 5_000_000  # the MVCC window (~5s)
+    MAX_VERSIONS_IN_FLIGHT = 100_000_000
+    # conflict set
+    CONFLICT_SET_BACKEND = "tpu"  # tpu | native | oracle (newConflictSet knob)
+    CONFLICT_SET_CAPACITY = 1 << 14
+    # storage
+    STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
+    STORAGE_FETCH_KEYS_BATCH = 10_000
+    # tlog
+    TLOG_SPILL_THRESHOLD = 1 << 20
+    # failure detection / recovery
+    HEARTBEAT_INTERVAL = 0.5
+    FAILURE_TIMEOUT = 2.0
+    # client
+    GRV_BATCH_INTERVAL = 0.001
+    CLIENT_MAX_RETRY_DELAY = 1.0
+    # simulation
+    SIM_MIN_LATENCY = 0.0001
+    SIM_MAX_LATENCY = 0.003
+    SIM_CLOG_MAX = 2.0
+
+    def __init__(self, **overrides):
+        for k, v in overrides.items():
+            if not hasattr(type(self), k):
+                raise KeyError(f"unknown knob {k!r}")
+            setattr(self, k, v)
+
+    def randomize(self, rng) -> None:
+        """Buggify-style knob randomization for simulation runs."""
+        if rng.coinflip(0.25):
+            self.COMMIT_BATCH_INTERVAL = rng.random_choice([0.0005, 0.002, 0.01])
+        if rng.coinflip(0.25):
+            self.GRV_BATCH_INTERVAL = rng.random_choice([0.0002, 0.001, 0.005])
+        if rng.coinflip(0.25):
+            self.MAX_BATCH_TXNS = rng.random_choice([8, 64, 1024])
+        if rng.coinflip(0.25):
+            self.CONFLICT_SET_CAPACITY = rng.random_choice([16, 256, 1 << 12])

@@ -1,0 +1,98 @@
+"""Deterministic single-threaded event loop with virtual time.
+
+The analog of the reference's Net2 run loop (flow/Net2.actor.cpp:545) and —
+more importantly — of the Sim2 deterministic simulator
+(fdbrpc/sim2.actor.cpp:720): all scheduling, timers, and randomness flow
+through one seeded loop, so any execution is exactly reproducible from its
+seed. Time is virtual: ``now()`` advances only when the loop runs a timer,
+never with wall-clock (the property that makes whole-cluster simulation of
+hours of activity run in seconds and replays bit-identical).
+
+Tasks carry priorities (the reference's ~40-level TaskPriority enum,
+flow/network.h:30-75, collapsed to the levels this system uses); ready tasks
+at the same time run in (priority, seq) order, with seq assigned at schedule
+time — deterministic FIFO within a priority.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Optional
+
+from .rng import DeterministicRandom
+
+
+class TaskPriority:
+    MAX = 1000000
+    COORDINATION = 8800
+    TLOG_COMMIT = 8570
+    PROXY_COMMIT = 8540
+    RESOLVER = 8700
+    DEFAULT = 7500
+    STORAGE = 6500
+    LOW = 2000
+    ZERO = 0
+
+
+class Cancelled(Exception):
+    """Raised inside an actor when its future is cancelled (the analog of
+    actor_cancelled, flow/error_definitions.h)."""
+
+
+class EventLoop:
+    """Priority run loop over virtual time. Single-threaded; determinism
+    comes from (time, -priority, seq) ordering and the seeded RNG."""
+
+    def __init__(self, seed: int = 0):
+        self._queue: list[tuple[float, int, int, Callable[[], None]]] = []
+        self._time = 0.0
+        self._seq = 0
+        self.random = DeterministicRandom(seed)
+        self.stopped = False
+        self._stall_detector: Optional[Callable[[], None]] = None
+
+    def now(self) -> float:
+        return self._time
+
+    def call_at(
+        self, when: float, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT
+    ) -> None:
+        self._seq += 1
+        heapq.heappush(self._queue, (max(when, self._time), -priority, self._seq, fn))
+
+    def call_soon(
+        self, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT
+    ) -> None:
+        self.call_at(self._time, fn, priority)
+
+    def run(self, until: float = float("inf"), stop_when: Callable[[], bool] = None):
+        """Drain tasks until the queue empties, virtual time passes ``until``,
+        or ``stop_when()`` turns true."""
+        while self._queue and not self.stopped:
+            when, negpri, seq, fn = self._queue[0]
+            if when > until:
+                break
+            heapq.heappop(self._queue)
+            self._time = max(self._time, when)
+            fn()
+            if stop_when is not None and stop_when():
+                break
+        return self._time
+
+
+_current: Optional[EventLoop] = None
+
+
+def current_loop() -> EventLoop:
+    if _current is None:
+        raise RuntimeError("no event loop active (use with_loop / Sim)")
+    return _current
+
+
+def set_loop(loop: Optional[EventLoop]) -> None:
+    global _current
+    _current = loop
+
+
+def now() -> float:
+    return current_loop().now()

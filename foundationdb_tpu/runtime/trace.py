@@ -1,0 +1,67 @@
+"""Structured trace events — the analog of flow/Trace.cpp TraceEvent.
+
+Events are dicts with severity, type, time, and process identity, collected
+per run (and optionally mirrored to a JSONL file, the counterpart of the
+reference's rolling XML trace logs). A SevError event marks the run failed —
+exactly the simulator's pass/fail criterion (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+SevDebug, SevInfo, SevWarn, SevWarnAlways, SevError = 5, 10, 20, 30, 40
+
+_SEV_NAMES = {5: "Debug", 10: "Info", 20: "Warn", 30: "WarnAlways", 40: "Error"}
+
+
+class TraceLog:
+    def __init__(self, path: Optional[str] = None, min_severity: int = SevInfo):
+        self.events: list[dict] = []
+        self.error_count = 0
+        self.min_severity = min_severity
+        self._file = open(path, "a") if path else None
+
+    def log(self, severity: int, event_type: str, time: float, process: str, **fields):
+        if severity < self.min_severity:
+            return
+        ev = {
+            "Severity": _SEV_NAMES.get(severity, severity),
+            "Type": event_type,
+            "Time": round(time, 6),
+            "Machine": process,
+            **fields,
+        }
+        self.events.append(ev)
+        if severity >= SevError:
+            self.error_count += 1
+        if self._file:
+            self._file.write(json.dumps(ev, default=str) + "\n")
+
+    def of_type(self, event_type: str) -> list[dict]:
+        return [e for e in self.events if e["Type"] == event_type]
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+_global_log = TraceLog(min_severity=SevInfo)
+
+
+def set_trace_log(log: TraceLog) -> None:
+    global _global_log
+    _global_log = log
+
+
+def trace_log() -> TraceLog:
+    return _global_log
+
+
+def trace(severity: int, event_type: str, process: str = "", **fields) -> None:
+    from .loop import _current
+
+    t = _current.now() if _current is not None else 0.0
+    _global_log.log(severity, event_type, t, process, **fields)

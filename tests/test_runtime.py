@@ -1,0 +1,200 @@
+"""Actor-runtime semantics tests — the dsltest analog (fdbrpc/dsltest.actor.cpp):
+futures/promises, streams, combinators, cancellation, priorities, determinism."""
+
+import pytest
+
+from foundationdb_tpu.runtime.futures import (
+    ActorCollection,
+    AsyncVar,
+    Cancelled,
+    Future,
+    Promise,
+    PromiseStream,
+    delay,
+    spawn,
+    timeout,
+    wait_for_all,
+    wait_for_any,
+    yield_now,
+)
+from foundationdb_tpu.runtime.loop import EventLoop, TaskPriority, set_loop
+
+
+@pytest.fixture
+def loop():
+    l = EventLoop(seed=1)
+    set_loop(l)
+    yield l
+    set_loop(None)
+
+
+def run(loop, fut, limit=1e6):
+    loop.run(until=limit, stop_when=fut.is_ready)
+    return fut.get()
+
+
+def test_promise_future_basics(loop):
+    p = Promise()
+
+    async def reader():
+        return await p.future
+
+    f = spawn(reader())
+    loop.run(until=0)
+    assert not f.is_ready()
+    p.send(42)
+    assert run(loop, f) == 42
+
+
+def test_delay_advances_virtual_time(loop):
+    async def sleeper():
+        t0 = loop.now()
+        await delay(5.0)
+        return loop.now() - t0
+
+    assert run(loop, spawn(sleeper())) == pytest.approx(5.0)
+
+
+def test_error_propagation(loop):
+    async def boom():
+        await yield_now()
+        raise ValueError("x")
+
+    async def catcher():
+        try:
+            await spawn(boom())
+        except ValueError as e:
+            return str(e)
+
+    assert run(loop, spawn(catcher())) == "x"
+
+
+def test_cancellation_reaches_actor(loop):
+    witness = []
+
+    async def victim():
+        try:
+            await delay(100)
+        except Cancelled:
+            witness.append("cancelled")
+            raise
+
+    f = spawn(victim())
+    loop.run(until=1)
+
+    async def killer():
+        f.cancel()
+        await yield_now()
+
+    run(loop, spawn(killer()))
+    loop.run(until=2)
+    assert witness == ["cancelled"]
+    assert f.is_error()
+
+
+def test_stream_fifo_and_blocking(loop):
+    s = PromiseStream()
+    got = []
+
+    async def consumer():
+        for _ in range(3):
+            got.append(await s.next())
+        return got
+
+    f = spawn(consumer())
+
+    async def producer():
+        s.send(1)
+        await delay(1)
+        s.send(2)
+        s.send(3)
+
+    spawn(producer())
+    assert run(loop, f) == [1, 2, 3]
+
+
+def test_wait_for_any_and_timeout(loop):
+    async def slow():
+        await delay(10)
+        return "slow"
+
+    async def use_timeout():
+        return await timeout(spawn(slow()), 1.0, default="timed out")
+
+    assert run(loop, spawn(use_timeout())) == "timed out"
+
+    async def fast_enough():
+        async def quick():
+            await delay(0.1)
+            return "ok"
+
+        return await timeout(spawn(quick()), 1.0)
+
+    assert run(loop, spawn(fast_enough())) == "ok"
+
+
+def test_async_var_wakes_waiters(loop):
+    v = AsyncVar(0)
+
+    async def watcher():
+        while v.get() < 3:
+            await v.on_change()
+        return v.get()
+
+    f = spawn(watcher())
+
+    async def bumper():
+        for i in range(1, 4):
+            await delay(1)
+            v.set(i)
+
+    spawn(bumper())
+    assert run(loop, f) == 3
+
+
+def test_actor_collection_propagates_errors(loop):
+    ac = ActorCollection()
+
+    async def fine():
+        await delay(1)
+
+    async def bad():
+        await delay(2)
+        raise RuntimeError("role died")
+
+    ac.add(spawn(fine()))
+    ac.add(spawn(bad()))
+    loop.run(until=5)
+    assert ac.error.is_error()
+    with pytest.raises(RuntimeError):
+        ac.error.get()
+
+
+def test_priority_ordering_same_time(loop):
+    order = []
+    loop.call_at(1.0, lambda: order.append("low"), TaskPriority.LOW)
+    loop.call_at(1.0, lambda: order.append("high"), TaskPriority.TLOG_COMMIT)
+    loop.call_at(1.0, lambda: order.append("mid"), TaskPriority.DEFAULT)
+    loop.run()
+    assert order == ["high", "mid", "low"]
+
+
+def test_determinism_same_seed_same_schedule():
+    def one_run(seed):
+        l = EventLoop(seed)
+        set_loop(l)
+        trace = []
+
+        async def chatter(name):
+            for _ in range(5):
+                await delay(l.random.random01())
+                trace.append((round(l.now(), 9), name))
+
+        for n in ["a", "b", "c"]:
+            spawn(chatter(n))
+        l.run()
+        set_loop(None)
+        return trace
+
+    assert one_run(7) == one_run(7)
+    assert one_run(7) != one_run(8)

@@ -1,0 +1,144 @@
+"""Simulator tests: deterministic multi-process echo, clogging, partitions,
+kill/reboot — the SURVEY.md §7 stage-2 milestone (seeded repro of a
+multi-process echo service)."""
+
+from foundationdb_tpu.net.sim import BrokenPromise, Endpoint, Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+
+
+def build_echo_world(seed):
+    sim = Sim(seed=seed)
+    sim.activate()
+
+    async def echo_boot(p):
+        async def echo(payload):
+            return ("echo", p.address, payload)
+
+        p.register("echo", echo)
+
+    for i in range(3):
+        sim.new_process(f"server{i}", boot=echo_boot)
+    client = sim.new_process("client")
+    return sim, client
+
+
+def test_echo_roundtrip():
+    sim, client = build_echo_world(1)
+
+    async def go():
+        r = await sim.request("client", Endpoint("server0", "echo"), "hi")
+        return r
+
+    out = sim.run_until_done(spawn(go()))
+    assert out == ("echo", "server0", "hi")
+    assert sim.loop.now() > 0  # latency was simulated
+
+
+def test_determinism_across_runs():
+    def one_run(seed):
+        sim, client = build_echo_world(seed)
+        log = []
+
+        async def go():
+            for i in range(10):
+                srv = f"server{sim.loop.random.random_int(0, 3)}"
+                r = await sim.request("client", Endpoint(srv, "echo"), i)
+                log.append((round(sim.loop.now(), 9), r))
+
+        sim.run_until_done(spawn(go()))
+        return log
+
+    assert one_run(42) == one_run(42)
+    assert one_run(42) != one_run(43)
+
+
+def test_dead_process_breaks_promise():
+    sim, client = build_echo_world(2)
+    sim.kill_process("server1")
+
+    async def go():
+        try:
+            await sim.request("client", Endpoint("server1", "echo"), "x")
+            return "replied"
+        except BrokenPromise:
+            return "broken"
+
+    assert sim.run_until_done(spawn(go())) == "broken"
+
+
+def test_reboot_restores_service():
+    sim, client = build_echo_world(3)
+    sim.kill_process("server2", reboot_in=5.0)
+
+    async def go():
+        # during downtime: broken
+        try:
+            await sim.request("client", Endpoint("server2", "echo"), 1)
+            first = "replied"
+        except BrokenPromise:
+            first = "broken"
+        await delay(10.0)
+        r = await sim.request("client", Endpoint("server2", "echo"), 2)
+        return first, r
+
+    first, r = sim.run_until_done(spawn(go()))
+    assert first == "broken"
+    assert r == ("echo", "server2", 2)
+    assert sim.processes["server2"].reboots == 1
+
+
+def test_clog_delays_delivery():
+    sim, client = build_echo_world(4)
+    sim.clog_pair("client", "server0", 3.0)
+
+    async def go():
+        t0 = sim.loop.now()
+        await sim.request("client", Endpoint("server0", "echo"), "x")
+        return sim.loop.now() - t0
+
+    dt = sim.run_until_done(spawn(go()))
+    assert dt >= 3.0
+
+
+def test_partition_drops_traffic_until_heal():
+    sim, client = build_echo_world(5)
+    sim.partition("client", "server0")
+
+    async def go():
+        f = sim.request("client", Endpoint("server0", "echo"), "x")
+        await delay(5.0)
+        stuck = not f.is_ready()
+        sim.heal()
+        r = await sim.request("client", Endpoint("server0", "echo"), "y")
+        return stuck, r
+
+    stuck, r = sim.run_until_done(spawn(go()))
+    assert stuck
+    assert r == ("echo", "server0", "y")
+
+
+def test_kill_cancels_in_flight_work():
+    sim = Sim(seed=6)
+    sim.activate()
+    witness = []
+
+    async def slow_boot(p):
+        async def slow(payload):
+            await delay(100.0)
+            witness.append("finished")  # must never happen
+            return "done"
+
+        p.register("slow", slow)
+
+    sim.new_process("victim", boot=slow_boot)
+    sim.new_process("client")
+
+    async def go():
+        f = sim.request("client", Endpoint("victim", "slow"), None)
+        await delay(1.0)
+        sim.kill_process("victim")
+        await delay(200.0)
+        return f.is_ready()
+
+    sim.run_until_done(spawn(go()))
+    assert witness == []
