@@ -1,0 +1,13 @@
+"""Key-value core data structures shared by client and server.
+
+The analog of the reference's common types layer (fdbclient/):
+- mutations.py    — mutation wire types (fdbclient/CommitTransaction.h:27-60)
+- atomic.py       — atomic-op apply functions (fdbclient/Atomic.h)
+- versioned_map.py— multi-version ordered map, the storage server's in-memory
+                    MVCC window (fdbclient/VersionedMap.h:31-68)
+- keyrange_map.py — key-range → value map (fdbclient/KeyRangeMap.h:36)
+"""
+
+from .mutations import Mutation, MutationType  # noqa: F401
+from .versioned_map import VersionedMap  # noqa: F401
+from .keyrange_map import KeyRangeMap  # noqa: F401

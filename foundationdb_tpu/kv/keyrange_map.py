@@ -1,0 +1,72 @@
+"""Key-range → value map.
+
+The analog of the reference's KeyRangeMap (fdbclient/KeyRangeMap.h:36 over
+fdbrpc/RangeMap.h): a total map over the key space [b"", ∞) represented as
+sorted boundary keys, each owning the half-open range up to the next boundary.
+Used for the shard map (key → storage team), the proxy's keyResolvers map,
+and — stage 7 — batched as the XLA interval-query primitive on the read path.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Iterator, Optional, Tuple
+
+
+class KeyRangeMap:
+    def __init__(self, default: Any = None) -> None:
+        self._bounds: list[bytes] = [b""]
+        self._vals: list[Any] = [default]
+
+    def _idx(self, key: bytes) -> int:
+        return bisect.bisect_right(self._bounds, key) - 1
+
+    def __getitem__(self, key: bytes) -> Any:
+        return self._vals[self._idx(key)]
+
+    def insert(self, begin: bytes, end: Optional[bytes], value: Any) -> None:
+        """Set value on [begin, end); end=None means to infinity."""
+        if end is not None and begin >= end:
+            return
+        # value that resumes at `end`
+        if end is not None:
+            resume = self._vals[self._idx(end)]
+        lo = bisect.bisect_left(self._bounds, begin)
+        hi = bisect.bisect_left(self._bounds, end) if end is not None else len(self._bounds)
+        new_bounds = [begin]
+        new_vals = [value]
+        if end is not None and (hi >= len(self._bounds) or self._bounds[hi] != end):
+            new_bounds.append(end)
+            new_vals.append(resume)
+        self._bounds[lo:hi] = new_bounds
+        self._vals[lo:hi] = new_vals
+
+    def ranges(self) -> Iterator[Tuple[bytes, Optional[bytes], Any]]:
+        """Yield (begin, end, value); final range has end=None (infinity)."""
+        for i, b in enumerate(self._bounds):
+            e = self._bounds[i + 1] if i + 1 < len(self._bounds) else None
+            yield b, e, self._vals[i]
+
+    def intersecting(
+        self, begin: bytes, end: Optional[bytes]
+    ) -> list[Tuple[bytes, Optional[bytes], Any]]:
+        """Ranges overlapping [begin, end), clipped to it."""
+        out = []
+        for b, e, v in self.ranges():
+            if end is not None and b >= end:
+                break
+            if e is not None and e <= begin:
+                continue
+            cb = max(b, begin)
+            ce = e if end is None else (end if e is None else min(e, end))
+            out.append((cb, ce, v))
+        return out
+
+    def coalesce(self) -> None:
+        """Merge adjacent ranges with equal values (CoalescedKeyRangeMap)."""
+        bounds, vals = [self._bounds[0]], [self._vals[0]]
+        for b, v in zip(self._bounds[1:], self._vals[1:]):
+            if v != vals[-1]:
+                bounds.append(b)
+                vals.append(v)
+        self._bounds, self._vals = bounds, vals

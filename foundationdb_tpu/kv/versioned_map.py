@@ -1,0 +1,130 @@
+"""Multi-version ordered map — the storage server's MVCC window.
+
+The analog of the reference's VersionedMap persistent treap
+(fdbclient/VersionedMap.h:31-68): holds the last few seconds of versions in
+memory so reads at any version in [oldest_version, latest_version] see a
+consistent snapshot. The reference uses a path-copying treap; here the same
+semantics come from per-key version-history lists over one sorted key index —
+simpler, and the batched-lookup form feeds the planned XLA range-query
+primitive (SURVEY.md §7 stage 7) where the treap's pointer-chasing could not.
+
+Mutations must be applied in nondecreasing version order (the storage server's
+update loop guarantees this, mirroring storageserver.actor.cpp:2321).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator, Optional
+
+
+def _find_le(h: list[tuple[int, Optional[bytes]]], version: int) -> int:
+    """Index of the last entry with entry.version <= version, else -1."""
+    lo, hi = 0, len(h)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if h[mid][0] <= version:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo - 1
+
+
+class VersionedMap:
+    def __init__(self) -> None:
+        self._keys: list[bytes] = []  # sorted; includes tombstoned keys until GC
+        self._hist: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
+        self.oldest_version = 0
+        self.latest_version = 0
+
+    # -- writes (version-ordered) ---------------------------------------------
+
+    def _append(self, key: bytes, version: int, value: Optional[bytes]) -> None:
+        h = self._hist.get(key)
+        if h is None:
+            self._hist[key] = [(version, value)]
+            bisect.insort(self._keys, key)
+        elif h[-1][0] == version:
+            h[-1] = (version, value)
+        else:
+            h.append((version, value))
+
+    def set(self, key: bytes, value: bytes, version: int) -> None:
+        assert version >= self.latest_version, "mutations must be version-ordered"
+        self.latest_version = version
+        self._append(key, version, value)
+
+    def clear_range(self, begin: bytes, end: bytes, version: int) -> None:
+        assert version >= self.latest_version
+        self.latest_version = version
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for key in self._keys[lo:hi]:
+            self._append(key, version, None)
+
+    def latest(self, key: bytes) -> Optional[bytes]:
+        """Value at latest_version (used when applying atomic ops)."""
+        h = self._hist.get(key)
+        return h[-1][1] if h else None
+
+    # -- reads ----------------------------------------------------------------
+
+    def _at(self, key: bytes, version: int) -> Optional[bytes]:
+        h = self._hist.get(key)
+        if not h:
+            return None
+        i = _find_le(h, version)
+        return h[i][1] if i >= 0 else None
+
+    def get(self, key: bytes, version: int) -> Optional[bytes]:
+        assert version >= self.oldest_version, "read below MVCC window"
+        return self._at(key, version)
+
+    def range(
+        self,
+        begin: bytes,
+        end: bytes,
+        version: int,
+        limit: int = 1 << 30,
+        reverse: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        assert version >= self.oldest_version
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        keys = self._keys[lo:hi]
+        if reverse:
+            keys = reversed(keys)
+        out: list[tuple[bytes, bytes]] = []
+        for k in keys:
+            v = self._at(k, version)
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    break
+        return out
+
+    def __iter__(self) -> Iterator[bytes]:
+        return iter(self._keys)
+
+    # -- compaction -----------------------------------------------------------
+
+    def forget_before(self, version: int) -> None:
+        """Advance oldest_version, dropping superseded history (the analog of
+        the storage server making versions durable and trimming the treap,
+        storageserver.actor.cpp:2536)."""
+        if version <= self.oldest_version:
+            return
+        version = min(version, self.latest_version)
+        dead: list[bytes] = []
+        for key, h in self._hist.items():
+            # keep the newest entry at-or-below `version` plus everything after
+            i = _find_le(h, version)
+            if i > 0:
+                del h[:i]
+            if len(h) == 1 and h[0][1] is None and h[0][0] <= version:
+                dead.append(key)
+        for key in dead:
+            del self._hist[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+        self.oldest_version = version

@@ -111,7 +111,8 @@ class Sim:
         def deliver():
             dst = self.processes.get(ep.address)
             if dst is None or not dst.alive or ep.token not in dst.endpoints:
-                self._reply_err(src, ep.address, reply, BrokenPromise(str(ep)))
+                # reply travels dst→src
+                self._reply_err(ep.address, src, reply, BrokenPromise(str(ep)))
                 return
             handler = dst.endpoints[ep.token]
 
@@ -180,7 +181,7 @@ class Sim:
 
     def reboot(self, address: str) -> None:
         p = self.processes.get(address)
-        if p is None or p.alive:
+        if p is None or p.alive or p.boot is None:
             return
         trace(SevInfo, "RebootProcess", address)
         p.alive = True

@@ -22,11 +22,13 @@ class Buggify:
         self._sites: dict[tuple[str, int], bool] = {}
         self.fired: set[tuple[str, int]] = set()
 
-    def __call__(self, site: Optional[tuple] = None) -> bool:
+    def __call__(self, site: Optional[tuple] = None, _depth: int = 1) -> bool:
         if self.rng is None:
             return False
         if site is None:
-            fr = inspect.currentframe().f_back
+            fr = inspect.currentframe()
+            for _ in range(_depth):
+                fr = fr.f_back
             site = (fr.f_code.co_filename, fr.f_lineno)
         if site not in self._sites:
             self._sites[site] = self.rng.coinflip(self.p_enabled)
@@ -45,4 +47,6 @@ def set_buggify(b: Buggify) -> None:
 
 
 def buggify(site: Optional[tuple] = None) -> bool:
-    return _buggify(site)
+    # _depth=2: attribute the site to the caller of this wrapper, not the
+    # wrapper itself — otherwise every call site collapses to one key.
+    return _buggify(site, _depth=2)

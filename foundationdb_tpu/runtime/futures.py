@@ -136,7 +136,12 @@ class Task:
             else:
                 awaited = self.coro.send(value)
         except StopIteration as stop:
-            self.future._set(stop.value)
+            # a cancelled actor never produces a value, even if its body
+            # swallowed the Cancelled and returned (flow: actor_cancelled)
+            if self._cancelled:
+                self.future._set_error(Cancelled())
+            else:
+                self.future._set(stop.value)
             return
         except Cancelled as c:
             self.future._set_error(c)
@@ -146,10 +151,24 @@ class Task:
             return
         # The coroutine yielded a Future it waits on.
         assert isinstance(awaited, Future), f"actors must await Futures, got {awaited!r}"
+        if self._cancelled:
+            # keep re-throwing at every await until the body exits, so an
+            # actor that catches Cancelled and awaits again can't hang forever
+            current_loop().call_soon(
+                lambda: self._step(None, Cancelled()), TaskPriority.MAX
+            )
+            return
         self._waiting_on = awaited
 
         def wake(f: Future, task=self):
-            if task._cancelled or task.future.is_ready():
+            # only the await the task is currently parked on may resume it
+            # (a stale pre-cancellation future can fire later); a cancelled
+            # task is resumed solely by the Cancelled re-throw in _step
+            if (
+                task.future.is_ready()
+                or task._cancelled
+                or task._waiting_on is not f
+            ):
                 return
             if f._error is not None:
                 current_loop().call_soon(
@@ -247,7 +266,7 @@ def wait_for_any(futures: list[Future]) -> Future[int]:
     def make_cb(i):
         def cb(f: Future):
             if not out.is_ready():
-                if f._error is not None and not isinstance(f._error, Cancelled):
+                if f._error is not None:
                     out._set_error(f._error)
                 else:
                     out._set(i)
@@ -316,9 +335,23 @@ class ActorCollection:
         self._actors.append(fut)
 
         def cb(f: Future):
-            if f._error is not None and not isinstance(f._error, Cancelled):
+            # A Cancelled error is benign only if the actor was itself
+            # cancelled (cancel_all / explicit cancel). Cancelled *propagated*
+            # from awaiting some other cancelled actor is a real failure
+            # (the reference's broken_promise) and must surface.
+            genuine_cancel = (
+                isinstance(f._error, Cancelled)
+                and f._task is not None
+                and f._task._cancelled
+            )
+            if f._error is not None and not genuine_cancel:
                 if not self.error.is_ready():
                     self.error._set_error(f._error)
+            # prune: completed actors (and their results) must not accumulate
+            try:
+                self._actors.remove(f)
+            except ValueError:
+                pass
 
         fut.add_callback(cb)
 

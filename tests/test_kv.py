@@ -1,0 +1,173 @@
+"""KV core: mutations, atomic ops, VersionedMap, KeyRangeMap.
+
+Differential style mirrors the reference's oracle-based workloads
+(fdbserver/workloads/MemoryKeyValueStore.h): the VersionedMap is fuzzed
+against per-version dict snapshots.
+"""
+
+import random
+
+from foundationdb_tpu.kv import KeyRangeMap, VersionedMap
+from foundationdb_tpu.kv.atomic import apply_atomic
+from foundationdb_tpu.kv.mutations import MutationType as MT
+
+
+# -- atomic ops ---------------------------------------------------------------
+
+
+def test_add_little_endian():
+    assert apply_atomic(MT.ADD, b"\x01\x00", b"\x01\x00") == b"\x02\x00"
+    assert apply_atomic(MT.ADD, b"\xff\x00", b"\x01\x00") == b"\x00\x01"
+    # wraps modulo 2^(8*len(param))
+    assert apply_atomic(MT.ADD, b"\xff\xff", b"\x01\x00") == b"\x00\x00"
+    # missing key: operand added to zero
+    assert apply_atomic(MT.ADD, None, b"\x05") == b"\x05"
+    # existing longer than operand: truncated to operand length
+    assert apply_atomic(MT.ADD, b"\x01\x02\x03", b"\x01") == b"\x02"
+
+
+def test_bitwise():
+    assert apply_atomic(MT.AND, b"\x0f", b"\x3c") == b"\x0c"
+    assert apply_atomic(MT.AND, None, b"\xff") == b"\x00"  # absent-as-zero
+    assert apply_atomic(MT.OR, b"\x0f", b"\x30") == b"\x3f"
+    assert apply_atomic(MT.XOR, b"\xff", b"\x0f") == b"\xf0"
+
+
+def test_min_max():
+    assert apply_atomic(MT.MAX, b"\x05", b"\x03") == b"\x05"
+    assert apply_atomic(MT.MIN, b"\x05", b"\x03") == b"\x03"
+    assert apply_atomic(MT.MAX, None, b"\x03") == b"\x03"
+    assert apply_atomic(MT.MIN, None, b"\x03") == b"\x03"
+    # little-endian comparison: b"\x00\x01" (256) > b"\x02\x00" (2)
+    assert apply_atomic(MT.MAX, b"\x00\x01", b"\x02\x00") == b"\x00\x01"
+    assert apply_atomic(MT.BYTE_MAX, b"aa", b"ab") == b"ab"
+    assert apply_atomic(MT.BYTE_MIN, b"aa", b"ab") == b"aa"
+    assert apply_atomic(MT.BYTE_MIN, None, b"zz") == b"zz"
+
+
+def test_append_and_cas():
+    assert apply_atomic(MT.APPEND_IF_FITS, b"ab", b"cd") == b"abcd"
+    assert apply_atomic(MT.APPEND_IF_FITS, None, b"x") == b"x"
+    assert apply_atomic(MT.COMPARE_AND_CLEAR, b"v", b"v") is None
+    assert apply_atomic(MT.COMPARE_AND_CLEAR, b"v", b"w") == b"v"
+
+
+# -- VersionedMap -------------------------------------------------------------
+
+
+def test_versioned_map_basics():
+    m = VersionedMap()
+    m.set(b"a", b"1", 10)
+    m.set(b"b", b"2", 10)
+    m.set(b"a", b"3", 20)
+    assert m.get(b"a", 10) == b"1"
+    assert m.get(b"a", 15) == b"1"
+    assert m.get(b"a", 20) == b"3"
+    assert m.get(b"b", 20) == b"2"
+    assert m.get(b"c", 20) is None
+    m.clear_range(b"a", b"b", 30)
+    assert m.get(b"a", 30) is None
+    assert m.get(b"a", 25) == b"3"
+    assert m.get(b"b", 30) == b"2"
+
+
+def test_versioned_map_range():
+    m = VersionedMap()
+    for i in range(10):
+        m.set(b"k%02d" % i, b"v%d" % i, 5)
+    m.clear_range(b"k03", b"k06", 10)
+    assert [k for k, _ in m.range(b"k00", b"k99", 5)] == [b"k%02d" % i for i in range(10)]
+    got = [k for k, _ in m.range(b"k00", b"k99", 10)]
+    assert got == [b"k00", b"k01", b"k02", b"k06", b"k07", b"k08", b"k09"]
+    got = m.range(b"k00", b"k99", 10, limit=2, reverse=True)
+    assert [k for k, _ in got] == [b"k09", b"k08"]
+
+
+def test_versioned_map_forget():
+    m = VersionedMap()
+    m.set(b"a", b"1", 10)
+    m.set(b"a", b"2", 20)
+    m.clear_range(b"a", b"b", 30)
+    m.set(b"c", b"3", 30)
+    m.forget_before(25)
+    assert m.get(b"a", 25) == b"2"
+    assert m.get(b"a", 30) is None
+    m.forget_before(35)
+    # tombstoned key fully below the window is gone; live key remains
+    assert m.get(b"a", 35) is None
+    assert m.get(b"c", 35) == b"3"
+    assert list(m) == [b"c"]
+
+
+def test_versioned_map_fuzz_vs_snapshots():
+    rng = random.Random(7)
+    m = VersionedMap()
+    model: dict[bytes, bytes] = {}
+    snapshots: dict[int, dict[bytes, bytes]] = {0: {}}
+    version = 0
+    keys = [b"k%02d" % i for i in range(30)]
+    for _ in range(300):
+        version += rng.randint(1, 3)
+        for _ in range(rng.randint(1, 4)):
+            op = rng.random()
+            if op < 0.6:
+                k, v = rng.choice(keys), b"v%d" % rng.randint(0, 999)
+                m.set(k, v, version)
+                model[k] = v
+            else:
+                a, b = sorted((rng.choice(keys), rng.choice(keys)))
+                m.clear_range(a, b, version)
+                for k in [k for k in model if a <= k < b]:
+                    del model[k]
+        snapshots[version] = dict(model)
+    # every snapshot readable at its version
+    versions = sorted(snapshots)
+    for v in versions:
+        expect = sorted(snapshots[v].items())
+        got = m.range(b"", b"\xff", v)
+        assert got == expect, f"at version {v}"
+    # compaction preserves reads at-or-above the horizon
+    horizon = versions[len(versions) // 2]
+    m.forget_before(horizon)
+    for v in versions:
+        if v >= horizon:
+            assert m.range(b"", b"\xff", v) == sorted(snapshots[v].items())
+
+
+# -- KeyRangeMap --------------------------------------------------------------
+
+
+def test_keyrange_map():
+    m = KeyRangeMap(default=0)
+    assert m[b"anything"] == 0
+    m.insert(b"b", b"d", 1)
+    m.insert(b"c", b"e", 2)
+    assert m[b"a"] == 0
+    assert m[b"b"] == 1
+    assert m[b"c"] == 2
+    assert m[b"d"] == 2
+    assert m[b"e"] == 0
+    rs = list(m.ranges())
+    assert rs == [(b"", b"b", 0), (b"b", b"c", 1), (b"c", b"e", 2), (b"e", None, 0)]
+    # clipped intersection
+    hits = m.intersecting(b"bb", b"dd")
+    assert hits == [(b"bb", b"c", 1), (b"c", b"dd", 2)]
+    # to-infinity insert + coalesce
+    m.insert(b"e", None, 2)
+    m.coalesce()
+    assert list(m.ranges()) == [(b"", b"b", 0), (b"b", b"c", 1), (b"c", None, 2)]
+
+
+def test_keyrange_map_fuzz_vs_dict():
+    rng = random.Random(3)
+    m = KeyRangeMap(default=-1)
+    probe = [bytes([c]) + bytes([d]) for c in range(97, 107) for d in range(97, 107)]
+    model = {p: -1 for p in probe}
+    for i in range(200):
+        a, b = sorted(rng.sample(probe, 2))
+        m.insert(a, b, i)
+        for p in probe:
+            if a <= p < b:
+                model[p] = i
+    for p in probe:
+        assert m[p] == model[p]

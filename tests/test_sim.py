@@ -140,5 +140,7 @@ def test_kill_cancels_in_flight_work():
         await delay(200.0)
         return f.is_ready()
 
-    sim.run_until_done(spawn(go()))
+    replied = sim.run_until_done(spawn(go()))
     assert witness == []
+    # the kill breaks the in-flight reply promise (it resolves, with an error)
+    assert replied
