@@ -1,0 +1,70 @@
+"""Database handle: connection to the cluster + the retry loop.
+
+The analog of fdbclient/NativeAPI's Cluster/Database (and the run-loop idiom
+every binding exposes, e.g. bindings/python/fdb/impl.py @transactional):
+holds the key-location cache (getKeyLocation:1059) and proxy endpoints, and
+``run()`` retries a transaction body on retryable errors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..net.sim import Endpoint, Sim
+from ..runtime.knobs import Knobs
+from ..kv.keyrange_map import KeyRangeMap
+from ..server.interfaces import GetKeyServersRequest, Tokens
+from .transaction import Transaction
+
+
+class Database:
+    def __init__(self, sim: Sim, proxy_addrs: list[str], client_addr: str = "client"):
+        self.sim = sim
+        self.knobs: Knobs = sim.knobs
+        self.proxy_addrs = proxy_addrs
+        self.client = sim.processes.get(client_addr) or sim.new_process(client_addr)
+        self.rng = sim.loop.random.fork()
+        # location cache: key range → team addresses (None = unknown)
+        self._locations = KeyRangeMap(default=None)
+
+    # -- routing ---------------------------------------------------------------
+
+    def _proxy_request(self, token: str, req):
+        addr = self.rng.random_choice(self.proxy_addrs)
+        return self.client.request(Endpoint(addr, token), req)
+
+    async def _locate(self, key: bytes):
+        """(shard begin, end, team) for key, cached (NativeAPI:1059)."""
+        cached = self._locations.range_for(key)
+        if cached[2] is not None:
+            return cached
+        reply = await self._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+        )
+        self._locations.insert(reply.begin, reply.end, reply.team)
+        return reply.begin, reply.end, reply.team
+
+    def invalidate_cache(self, key: bytes) -> None:
+        b, e, _ = self._locations.range_for(key)
+        self._locations.insert(b, e, None)
+
+    # -- transactions ----------------------------------------------------------
+
+    def transaction(self) -> Transaction:
+        return Transaction(self)
+
+    async def run(self, body, max_retries: Optional[int] = None):
+        """Run ``await body(tr)`` then commit, retrying on retryable errors —
+        the @transactional decorator semantics all bindings share."""
+        tr = self.transaction()
+        attempt = 0
+        while True:
+            try:
+                result = await body(tr)
+                await tr.commit()
+                return result
+            except Exception as e:
+                attempt += 1
+                if max_retries is not None and attempt > max_retries:
+                    raise
+                await tr.on_error(e)  # re-raises if not retryable

@@ -1,0 +1,357 @@
+"""Client Transaction with read-your-writes semantics.
+
+The analog of fdbclient/NativeAPI.actor.cpp's Transaction (get:1863,
+commit:2571) merged with the ReadYourWrites overlay
+(fdbclient/ReadYourWrites.actor.cpp:46-142 + WriteMap, fdbclient/WriteMap.h:119):
+
+- reads see this transaction's own uncommitted writes layered over a
+  snapshot at the read version;
+- the write overlay collapses eagerly: a set replaces prior ops on that key,
+  a clear turns keys into determined-None, an atomic op chains onto a
+  determined value immediately or waits for the storage base value
+  (the reference's "unmodified/independent/dependent" op-stack states);
+- every read records a read conflict range, every write a write conflict
+  range (unless snapshot/disabled), exactly what the resolver checks;
+- reads route via a key-location cache (getKeyLocation, NativeAPI:1059)
+  and load-balance across the storage team (LoadBalance.actor.h:158).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..errors import (
+    AccessedUnreadable,
+    CommitUnknownResult,
+    FdbError,
+    FutureVersion,
+    NotCommitted,
+    TransactionTooOld,
+)
+from ..kv.atomic import apply_atomic
+from ..kv.keyrange_map import KeyRangeMap
+from ..kv.mutations import Mutation, MutationType
+from ..net.sim import BrokenPromise, Endpoint
+from ..runtime.futures import delay
+from ..server.interfaces import (
+    CommitRequest,
+    GetKeyValuesRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+    Tokens,
+    TransactionData,
+)
+
+MAX_READ_ATTEMPTS = 60
+FUTURE_VERSION_RETRY_DELAY = 0.05
+
+
+def strinc(key: bytes) -> bytes:
+    """Least key strictly greater than every key prefixed by `key`
+    (the bindings' strinc; used for prefix ranges)."""
+    key = key.rstrip(b"\xff")
+    if not key:
+        raise ValueError("no upper bound for all-0xff prefix")
+    return key[:-1] + bytes([key[-1] + 1])
+
+
+def key_after(key: bytes) -> bytes:
+    return key + b"\x00"
+
+
+class Transaction:
+    def __init__(self, db):
+        self.db = db
+        self._read_version: Optional[int] = None
+        # RYW overlay: key → ("value", v|None) | ("ops", [(type, param), ...])
+        self._writes: dict[bytes, tuple] = {}
+        self._cleared = KeyRangeMap(default=False)  # key covered by a clear?
+        self._mutations: list[Mutation] = []
+        self._rcr: list[tuple[bytes, bytes]] = []
+        self._wcr: list[tuple[bytes, bytes]] = []
+        self._unreadable: set[bytes] = set()  # versionstamped-key placeholders
+        self.committed_version: Optional[int] = None
+        self.versionstamp: Optional[bytes] = None
+
+    # -- read version ----------------------------------------------------------
+
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            reply = await self.db._proxy_request(Tokens.GRV, GetReadVersionRequest())
+            self._read_version = reply.version
+        return self._read_version
+
+    def set_read_version(self, version: int) -> None:
+        self._read_version = version
+
+    # -- writes (RYW overlay + mutation log) -----------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._writes[key] = ("value", value)
+        self._mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self._wcr.append((key, key_after(key)))
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        if begin >= end:
+            return
+        for k in list(self._writes):
+            if begin <= k < end:
+                self._writes[k] = ("value", None)
+        self._cleared.insert(begin, end, True)
+        self._mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self._wcr.append((begin, end))
+
+    def atomic_op(self, op: MutationType, key: bytes, param: bytes) -> None:
+        cur = self._writes.get(key)
+        if cur is None and self._cleared[key]:
+            cur = ("value", None)
+        if cur is None:
+            self._writes[key] = ("ops", [(op, param)])
+        elif cur[0] == "value":
+            self._writes[key] = ("value", apply_atomic(op, cur[1], param))
+        else:
+            self._writes[key] = ("ops", cur[1] + [(op, param)])
+        self._mutations.append(Mutation(op, key, param))
+        self._wcr.append((key, key_after(key)))
+
+    def set_versionstamped_key(self, key_with_offset: bytes, value: bytes) -> None:
+        """key_with_offset: key bytes containing a 10-byte placeholder,
+        followed by a 4-byte little-endian offset of the placeholder."""
+        self._mutations.append(
+            Mutation(MutationType.SET_VERSIONSTAMPED_KEY, key_with_offset, value)
+        )
+        body = key_with_offset[:-4]
+        self._unreadable.add(body)
+        self._wcr.append((body, key_after(body)))
+
+    def set_versionstamped_value(self, key: bytes, value_with_offset: bytes) -> None:
+        self._mutations.append(
+            Mutation(MutationType.SET_VERSIONSTAMPED_VALUE, key, value_with_offset)
+        )
+        self._unreadable.add(key)
+        self._wcr.append((key, key_after(key)))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._rcr.append((begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._wcr.append((begin, end))
+
+    # -- reads -----------------------------------------------------------------
+
+    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        if key in self._unreadable:
+            raise AccessedUnreadable()
+        w = self._writes.get(key)
+        if w is not None and w[0] == "value":
+            # fully determined by this txn's writes: no storage read, and —
+            # matching the reference — still a read conflict (the value
+            # "read" depends on what this txn observed)... except a plain
+            # overwrite never observed the database. RYW reads of our own
+            # sets add no conflict range (ReadYourWrites 'read from write').
+            return w[1]
+        if not snapshot:
+            self._rcr.append((key, key_after(key)))
+        if w is None and self._cleared[key]:
+            return None
+        base = await self._storage_get(key)
+        if w is None:
+            return base
+        # pending atomic chain over the storage base
+        v = base
+        for op, param in w[1]:
+            v = apply_atomic(op, v, param)
+        return v
+
+    async def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        limit: int = 1 << 30,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        assert not reverse or limit < (1 << 30), "reverse needs a limit"
+        out = await self._get_range_merged(begin, end, limit, reverse)
+        if not snapshot:
+            # conflict on the portion actually observed (NativeAPI clamps
+            # the range at the last returned key when the limit was hit)
+            if len(out) >= limit and out:
+                if reverse:
+                    self._rcr.append((out[-1][0], end))
+                else:
+                    self._rcr.append((begin, key_after(out[-1][0])))
+            else:
+                self._rcr.append((begin, end))
+        return out
+
+    async def _get_range_merged(self, begin, end, limit, reverse):
+        """Merge storage rows at the read version with the write overlay
+        (the RYWIterator's job, fdbclient/RYWIterator.cpp), window by
+        window: each storage reply defines an exactly-known key window
+        (everything up to its last row, or the whole remainder when
+        ``more`` is false), inside which overlay merging is exact — so
+        truncated replies and overlay-dropped rows can't lose keys."""
+        out: list[tuple[bytes, bytes]] = []
+        lo, hi = begin, end
+        while len(out) < limit and lo < hi:
+            if not reverse:
+                rows, next_lo = await self._storage_window(lo, hi, limit - len(out))
+                w_hi = next_lo if next_lo is not None else hi
+                out.extend(self._merge_window(rows, lo, w_hi, reverse=False))
+                if next_lo is None:
+                    break
+                lo = next_lo
+            else:
+                rows, next_hi = await self._storage_window_rev(
+                    lo, hi, limit - len(out)
+                )
+                w_lo = next_hi if next_hi is not None else lo
+                out.extend(self._merge_window(rows, w_lo, hi, reverse=True))
+                if next_hi is None:
+                    break
+                hi = next_hi
+        return out[:limit]
+
+    def _merge_window(self, rows, lo, hi, reverse):
+        """Exact merge inside [lo, hi): storage absence is genuine here."""
+        merged: dict[bytes, Optional[bytes]] = {}
+        for k, v in rows:
+            if lo <= k < hi and not (self._cleared[k] and k not in self._writes):
+                merged[k] = v
+        for k, w in self._writes.items():
+            if lo <= k < hi:
+                if w[0] == "value":
+                    v = w[1]
+                else:
+                    v = merged.get(k)  # absent in window = absent in storage
+                    for op, param in w[1]:
+                        v = apply_atomic(op, v, param)
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+        return sorted(merged.items(), reverse=reverse)
+
+    # -- storage routing (getKeyLocation + loadBalance) ------------------------
+
+    async def _storage_get(self, key: bytes) -> Optional[bytes]:
+        version = await self.get_read_version()
+        req = GetValueRequest(key=key, version=version)
+        reply = await self._load_balanced(key, Tokens.GET_VALUE, req)
+        return reply.value
+
+    async def _storage_window(self, lo, hi, limit):
+        """One forward storage fetch. Returns (rows, next_lo): next_lo is
+        where the next window starts, or None when [lo, hi) is fully
+        covered by this reply (shard splits + `more` both advance it)."""
+        version = await self.get_read_version()
+        s_begin, s_end, _team = await self.db._locate(lo)
+        chunk_hi = hi if s_end is None else min(hi, s_end)
+        req = GetKeyValuesRequest(begin=lo, end=chunk_hi, version=version, limit=limit)
+        reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
+        if reply.more:
+            return reply.data, key_after(reply.data[-1][0])
+        if chunk_hi < hi:
+            return reply.data, chunk_hi
+        return reply.data, None
+
+    async def _storage_window_rev(self, lo, hi, limit):
+        """One reverse storage fetch; next_hi bounds the next window."""
+        version = await self.get_read_version()
+        # single-shard reverse only until shard-aware backward iteration
+        # (stage 6 widens this)
+        _b, s_end, _team = await self.db._locate(lo)
+        assert s_end is None or s_end >= hi, "reverse range across shards: not yet"
+        req = GetKeyValuesRequest(
+            begin=lo, end=hi, version=version, limit=limit, reverse=True
+        )
+        reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
+        if reply.more:
+            return reply.data, reply.data[-1][0]
+        return reply.data, None
+
+    async def _load_balanced(self, key: bytes, token: str, req):
+        """Replica selection with retry — LoadBalance.actor.h:158."""
+        version_retries = 0
+        last_err: Exception = None
+        for attempt in range(MAX_READ_ATTEMPTS):
+            _b, _e, team = await self.db._locate(key)
+            order = list(range(len(team)))
+            self.db.rng.shuffle(order)
+            for i in order:
+                ep = Endpoint(team[i], token)
+                try:
+                    return await self.db.client.request(ep, req)
+                except BrokenPromise as e:
+                    last_err = e
+                    continue
+                except FutureVersion as e:
+                    last_err = e
+                    break  # replica behind: wait, then retry the team
+            if isinstance(last_err, FutureVersion):
+                version_retries += 1
+                if version_retries > 20:
+                    raise last_err
+                await delay(FUTURE_VERSION_RETRY_DELAY)
+            else:
+                # whole team unreachable: drop cache, back off, re-locate
+                self.db.invalidate_cache(key)
+                await delay(0.1)
+        raise last_err or BrokenPromise("read retries exhausted")
+
+    # -- commit ----------------------------------------------------------------
+
+    async def commit(self) -> int:
+        if not self._mutations and not self._wcr:
+            # read-only: committing at the read version with no writes
+            self.committed_version = self._read_version or 0
+            return self.committed_version
+        data = TransactionData(
+            read_snapshot=await self.get_read_version() if self._rcr else 0,
+            read_conflict_ranges=_dedup(self._rcr),
+            write_conflict_ranges=_dedup(self._wcr),
+            mutations=self._mutations,
+        )
+        try:
+            reply = await self.db._proxy_request(
+                Tokens.COMMIT, CommitRequest(transaction=data)
+            )
+        except (NotCommitted, TransactionTooOld):
+            raise
+        except BrokenPromise:
+            raise CommitUnknownResult()
+        self.committed_version = reply.version
+        self.versionstamp = reply.versionstamp
+        return reply.version
+
+    def get_versionstamp(self) -> bytes:
+        assert self.committed_version is not None, "commit first"
+        return self.versionstamp
+
+    # -- retry loop ------------------------------------------------------------
+
+    def reset(self) -> None:
+        backoff = getattr(self, "_backoff", 0.0)
+        self.__init__(self.db)
+        self._backoff = backoff
+
+    async def on_error(self, e: Exception) -> None:
+        """Backoff + reset for retryable errors (Transaction::onError,
+        NativeAPI.actor.cpp)."""
+        if not isinstance(e, FdbError) or not e.retryable:
+            raise e
+        self._backoff = min(
+            max(getattr(self, "_backoff", 0.0) * 2, 0.01),
+            self.db.knobs.CLIENT_MAX_RETRY_DELAY,
+        )
+        wait = self._backoff * (0.5 + self.db.rng.random01() * 0.5)
+        self.reset()
+        await delay(wait)
+
+
+def _dedup(ranges: list[tuple[bytes, bytes]]) -> list[tuple[bytes, bytes]]:
+    return sorted(set(ranges))

@@ -1,0 +1,50 @@
+"""Error taxonomy — the analog of flow/error_definitions.h.
+
+Typed exceptions replace the reference's numbered error codes; the subset
+here is the one that crosses the client API (retryable vs not mirrors
+fdb_error_predicate in bindings/c/fdb_c.cpp).
+"""
+
+from __future__ import annotations
+
+
+class FdbError(Exception):
+    retryable = False
+
+
+class NotCommitted(FdbError):
+    """Transaction conflicted with another (error 1020)."""
+
+    retryable = True
+
+
+class TransactionTooOld(FdbError):
+    """Read snapshot fell out of the MVCC window (error 1007)."""
+
+    retryable = True
+
+
+class FutureVersion(FdbError):
+    """Storage server not yet caught up to read version (error 1009)."""
+
+    retryable = True
+
+
+class CommitUnknownResult(FdbError):
+    """Connection to proxy lost mid-commit; txn may or may not have
+    committed (error 1021). Retryable, but retries must be idempotent."""
+
+    retryable = True
+
+
+class KeyOutsideLegalRange(FdbError):
+    pass
+
+
+class AccessedUnreadable(FdbError):
+    """Read of a key written with a versionstamp op this transaction
+    (error 1036)."""
+
+
+class DatabaseShutdown(FdbError):
+    pass

@@ -6,8 +6,8 @@ V1 compatibility shims):
 
 - arithmetic/bitwise ops produce a result of the *operand's* length, with the
   existing value zero-extended or truncated to match;
-- on a missing key, ADD/OR/XOR/MAX/MIN/BYTE_MIN/BYTE_MAX store the operand,
-  AND stores zeros (AND against absent-as-zero), APPEND stores the operand;
+- on a missing key, every op (including AND, per the reference's doAndV2)
+  stores the operand;
 - COMPARE_AND_CLEAR returns None (clear) iff the existing value equals the
   operand.
 
@@ -39,6 +39,8 @@ def do_add(existing: Optional[bytes], param: bytes) -> bytes:
 
 
 def do_and(existing: Optional[bytes], param: bytes) -> bytes:
+    if existing is None:
+        return param  # doAndV2: absent key stores the operand
     e = _fit(existing, len(param))
     return bytes(x & y for x, y in zip(e, param))
 

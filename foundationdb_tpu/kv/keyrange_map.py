@@ -24,6 +24,12 @@ class KeyRangeMap:
     def __getitem__(self, key: bytes) -> Any:
         return self._vals[self._idx(key)]
 
+    def range_for(self, key: bytes) -> Tuple[bytes, Optional[bytes], Any]:
+        """(begin, end, value) of the range containing key; end=None is ∞."""
+        i = self._idx(key)
+        end = self._bounds[i + 1] if i + 1 < len(self._bounds) else None
+        return self._bounds[i], end, self._vals[i]
+
     def insert(self, begin: bytes, end: Optional[bytes], value: Any) -> None:
         """Set value on [begin, end); end=None means to infinity."""
         if end is not None and begin >= end:

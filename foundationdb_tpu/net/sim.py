@@ -63,6 +63,10 @@ class SimProcess:
         self.actors.add(fut)
         return fut
 
+    def request(self, ep: "Endpoint", payload: Any) -> Future:
+        """RPC originating from this process (its address is the source)."""
+        return self.sim.request(self.address, ep, payload)
+
 
 class Sim:
     """One simulated cluster world bound to one event loop."""
@@ -118,9 +122,12 @@ class Sim:
 
             async def run_and_reply():
                 try:
-                    # the handler itself is owned by the destination process,
-                    # so kill_process cancels it mid-flight
-                    result = await dst.spawn(handler(payload))
+                    # the handler runs inline in this actor (owned by the
+                    # destination process, so kill_process cancels it
+                    # mid-flight); routine request errors are relayed to the
+                    # caller and must NOT latch the process's actor-failure
+                    # channel, hence no separate spawn
+                    result = await handler(payload)
                 except Cancelled:
                     self._reply_err(ep.address, src, reply, BrokenPromise(str(ep)))
                     return

@@ -323,6 +323,31 @@ class AsyncTrigger:
         return self._f
 
 
+class VersionGate:
+    """Orders batch application by (prev_version → version) chaining — the
+    sequencing discipline shared by resolvers (Resolver.actor.cpp:104-122)
+    and tlogs (tLogCommit version ordering): a batch waits until the gate
+    reaches its prev_version, applies, then advances the gate to its own
+    version."""
+
+    def __init__(self, version: int = 0):
+        self.version = version
+        self._waiters: dict[int, Future] = {}  # target version → wakeup
+
+    async def wait_until(self, version: int) -> None:
+        while self.version < version:
+            f = self._waiters.get(version)
+            if f is None:
+                f = self._waiters[version] = Future()
+            await f
+
+    def advance_to(self, version: int) -> None:
+        if version > self.version:
+            self.version = version
+            for t in [t for t in self._waiters if t <= version]:
+                self._waiters.pop(t)._set(None)
+
+
 class ActorCollection:
     """Holds actor futures; errors propagate, completions are discarded
     (flow/ActorCollection.actor.cpp)."""

@@ -1,0 +1,8 @@
+"""Server roles: master, proxy, resolver, tlog, storage + cluster assembly.
+
+The analog of fdbserver/ (SURVEY.md §1 L3). Each role is a plain class whose
+async handlers register on a simulated process (net/sim.py); the same role
+code will sit behind the real-TCP transport when it lands.
+"""
+
+from .cluster import Cluster, ClusterConfig  # noqa: F401

@@ -1,0 +1,130 @@
+"""Cluster assembly: build a full database in the simulator.
+
+The analog of fdbserver/SimulatedCluster.actor.cpp (setupSimulatedSystem:886)
+for the static-recruitment stage: given a shape (counts of each role), create
+one simulated process per role, wire the endpoints, and lay out shards/tags:
+
+- storage server i carries tag i (fdbclient/FDBTypes.h:39 Tag)
+- storage servers group into teams of `replication` size; the key space is
+  split evenly (by first byte) across teams — the static form of the
+  shard map kept in \xff/keyServers/ (fdbclient/SystemData.cpp:33)
+- tag t lives on tlog (t mod n_tlogs); proxies push each version to every
+  tlog (TagPartitionedLogSystem push, filtered per tlog's tags)
+- the conflict-resolution key space splits evenly across resolvers
+  (the keyResolvers map, MasterProxyServer.actor.cpp:233)
+
+Dynamic recruitment/recovery (ClusterController + master state machine)
+replaces this in the distribution stage (SURVEY.md §7 stage 6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..kv.keyrange_map import KeyRangeMap
+from ..net.sim import Endpoint, Sim
+from ..runtime.knobs import Knobs
+from .interfaces import Tokens
+from .master import Master
+from .proxy import Proxy, ShardMap
+from .resolver import Resolver
+from .storage import StorageServer
+from .tlog import TLog
+
+
+@dataclass
+class ClusterConfig:
+    n_proxies: int = 1
+    n_resolvers: int = 1
+    n_tlogs: int = 1
+    n_storage: int = 1
+    replication: int = 1  # storage replicas per shard (team size)
+    conflict_backend: str = "oracle"
+
+
+def _split_points(n: int) -> list[bytes]:
+    """n-way even split of the key space by first byte."""
+    return [bytes([(256 * i) // n]) for i in range(1, n)]
+
+
+class Cluster:
+    def __init__(self, sim: Sim, config: ClusterConfig = None, knobs: Knobs = None):
+        self.sim = sim
+        self.config = cfg = config or ClusterConfig()
+        self.knobs = knobs or sim.knobs
+        assert cfg.n_storage % cfg.replication == 0, "storage must fill teams"
+
+        # master
+        self.master = Master()
+        p = sim.new_process("master")
+        self.master.register(p)
+
+        # tlogs: tag t → tlog (t mod n_tlogs)
+        self.tlogs: list[TLog] = []
+        tlog_eps, tlog_tags = [], {}
+        all_tags = list(range(cfg.n_storage))
+        for i in range(cfg.n_tlogs):
+            owned = frozenset(t for t in all_tags if t % cfg.n_tlogs == i)
+            tl = TLog(self.knobs, tags=owned)
+            addr = f"tlog{i}"
+            tl.register(sim.new_process(addr))
+            self.tlogs.append(tl)
+            tlog_eps.append(Endpoint(addr, Tokens.TLOG_COMMIT))
+            tlog_tags[addr] = owned
+
+        # storage: teams of `replication` servers; even key split across teams
+        self.storages: list[StorageServer] = []
+        shards = ShardMap()
+        n_teams = cfg.n_storage // cfg.replication
+        bounds = [b""] + _split_points(n_teams) + [None]
+        for team in range(n_teams):
+            members = range(team * cfg.replication, (team + 1) * cfg.replication)
+            addrs = [f"ss{t}" for t in members]
+            shards.set_shard(bounds[team], bounds[team + 1], addrs, list(members))
+        for t in range(cfg.n_storage):
+            tlog_addr = f"tlog{t % cfg.n_tlogs}"
+            ss = StorageServer(
+                tag=t, tlog_ep=Endpoint(tlog_addr, Tokens.TLOG_PEEK), knobs=self.knobs
+            )
+            ss.register(sim.new_process(f"ss{t}"))
+            self.storages.append(ss)
+        self.shards = shards
+
+        # resolvers: even key split
+        self.resolvers: list[Resolver] = []
+        resolver_map = KeyRangeMap()
+        rbounds = [b""] + _split_points(cfg.n_resolvers) + [None]
+        for i in range(cfg.n_resolvers):
+            r = Resolver(self.knobs, backend=cfg.conflict_backend)
+            addr = f"resolver{i}"
+            r.register(sim.new_process(addr))
+            self.resolvers.append(r)
+            resolver_map.insert(
+                rbounds[i], rbounds[i + 1], Endpoint(addr, Tokens.RESOLVE)
+            )
+
+        # proxies
+        self.proxies: list[Proxy] = []
+        self.proxy_addrs: list[str] = []
+        for i in range(cfg.n_proxies):
+            pr = Proxy(
+                master_addr="master",
+                resolver_map=resolver_map,
+                tlog_eps=tlog_eps,
+                tlog_tags=tlog_tags,
+                shards=shards,
+                knobs=self.knobs,
+            )
+            addr = f"proxy{i}"
+            pr.register(sim.new_process(addr))
+            self.proxies.append(pr)
+            self.proxy_addrs.append(addr)
+
+    # -- test/ops helpers ------------------------------------------------------
+
+    def storage_for_tag(self, tag: int) -> StorageServer:
+        return self.storages[tag]
+
+    def quiesce_version(self) -> int:
+        """Highest committed version (for draining in tests — QuietDatabase)."""
+        return self.master.live_committed

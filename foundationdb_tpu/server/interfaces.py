@@ -1,0 +1,193 @@
+"""Role interfaces: the typed requests that cross process boundaries.
+
+The analog of the reference's *Interface.h structs of RequestStreams
+(MasterInterface.h, ResolverInterface.h, TLogInterface.h,
+StorageServerInterface.h, MasterProxyInterface.h). An interface here is a
+set of (endpoint token, request dataclass) pairs; net.sim routes them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..kv.mutations import Mutation
+
+Version = int
+Tag = int  # per-storage-server tag (fdbclient/FDBTypes.h:39)
+
+INVALID_VERSION = -1
+
+
+# -- transactions over the wire ----------------------------------------------
+
+
+@dataclass
+class TransactionData:
+    """Client → proxy commit payload: the analog of CommitTransactionRef
+    (fdbclient/CommitTransaction.h): conflict ranges + mutations +
+    read snapshot."""
+
+    read_snapshot: Version = INVALID_VERSION
+    read_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    write_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
+    mutations: list[Mutation] = field(default_factory=list)
+
+
+# -- master (version assignment; masterserver.actor.cpp:763 getVersion) -------
+
+
+@dataclass
+class GetCommitVersionRequest:
+    requesting_proxy: str = ""
+
+
+@dataclass
+class GetCommitVersionReply:
+    prev_version: Version = INVALID_VERSION
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class ReportRawCommittedVersionRequest:
+    version: Version = INVALID_VERSION
+
+
+# -- proxy (MasterProxyInterface.h) -------------------------------------------
+
+
+@dataclass
+class GetReadVersionRequest:
+    pass
+
+
+@dataclass
+class GetReadVersionReply:
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class CommitRequest:
+    transaction: TransactionData = None
+
+
+@dataclass
+class CommitReply:
+    version: Version = INVALID_VERSION  # commit version if committed
+    versionstamp: bytes = b""
+
+
+@dataclass
+class GetKeyServersRequest:
+    """Key-location query (NativeAPI getKeyLocation → proxy
+    readRequestServer, MasterProxyServer.actor.cpp:1036)."""
+
+    key: bytes = b""
+
+
+@dataclass
+class GetKeyServersReply:
+    # (shard_begin, shard_end, [storage addresses])
+    begin: bytes = b""
+    end: Optional[bytes] = None
+    team: list[str] = field(default_factory=list)
+
+
+# -- resolver (ResolverInterface.h / ResolveTransactionBatchRequest) ----------
+
+
+@dataclass
+class ResolveBatchRequest:
+    prev_version: Version = INVALID_VERSION
+    version: Version = INVALID_VERSION
+    last_receive_version: Version = INVALID_VERSION
+    requesting_proxy: str = ""
+    transactions: list[TransactionData] = field(default_factory=list)
+
+
+@dataclass
+class ResolveBatchReply:
+    committed: list[int] = field(default_factory=list)  # Verdict per txn
+
+
+# -- tlog (TLogInterface.h) ---------------------------------------------------
+
+
+@dataclass
+class TLogCommitRequest:
+    prev_version: Version = INVALID_VERSION
+    version: Version = INVALID_VERSION
+    # tag → mutations at this version (LogPushData's tagged messages)
+    messages: dict[Tag, list[Mutation]] = field(default_factory=dict)
+
+
+@dataclass
+class TLogPeekRequest:
+    tag: Tag = 0
+    begin: Version = 0
+
+
+@dataclass
+class TLogPeekReply:
+    # [(version, mutations)] with version >= begin, ascending
+    messages: list[tuple[Version, list[Mutation]]] = field(default_factory=list)
+    end_version: Version = INVALID_VERSION  # data complete through this version
+
+
+@dataclass
+class TLogPopRequest:
+    tag: Tag = 0
+    upto: Version = INVALID_VERSION
+
+
+# -- storage (StorageServerInterface.h) ---------------------------------------
+
+
+@dataclass
+class GetValueRequest:
+    key: bytes = b""
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class GetValueReply:
+    value: Optional[bytes] = None
+
+
+@dataclass
+class GetKeyValuesRequest:
+    begin: bytes = b""
+    end: bytes = b""
+    version: Version = INVALID_VERSION
+    limit: int = 1 << 30
+    reverse: bool = False
+
+
+@dataclass
+class GetKeyValuesReply:
+    data: list[tuple[bytes, bytes]] = field(default_factory=list)
+    more: bool = False
+
+
+# -- endpoint token names (well-known, fdbrpc/fdbrpc.h:56) --------------------
+
+
+class Tokens:
+    # master
+    GET_COMMIT_VERSION = "master.getCommitVersion"
+    REPORT_COMMITTED = "master.reportCommitted"
+    GET_LIVE_COMMITTED = "master.getLiveCommitted"
+    # proxy
+    GRV = "proxy.getConsistentReadVersion"
+    COMMIT = "proxy.commit"
+    GET_KEY_SERVERS = "proxy.getKeyServers"
+    # resolver
+    RESOLVE = "resolver.resolve"
+    # tlog
+    TLOG_COMMIT = "tlog.commit"
+    TLOG_PEEK = "tlog.peek"
+    TLOG_POP = "tlog.pop"
+    # storage
+    GET_VALUE = "storage.getValue"
+    GET_KEY_VALUES = "storage.getKeyValues"
+    GET_SHARD_STATE = "storage.getShardState"

@@ -1,0 +1,64 @@
+"""Master role: commit-version assignment and committed-version tracking.
+
+The analog of the reference's version-assignment half of the master
+(fdbserver/masterserver.actor.cpp: getVersion:763 / provideVersions:830 and
+the liveCommittedVersion bookkeeping). The recovery state machine joins in
+the distribution stage (SURVEY.md §7 stage 6); here the master is the
+cluster's single version authority:
+
+- ``getCommitVersion`` hands out a strictly increasing (prev_version,
+  version) pair per commit batch; the prev→version chain is what lets
+  resolvers and tlogs apply batches in version order with no other
+  coordination (Resolver.actor.cpp:104-122).
+- Commit versions advance with wall (virtual) time at VERSIONS_PER_SECOND so
+  versions double as coarse timestamps, like the reference.
+"""
+
+from __future__ import annotations
+
+from ..runtime.loop import now
+from .interfaces import (
+    GetCommitVersionReply,
+    GetCommitVersionRequest,
+    GetReadVersionReply,
+    ReportRawCommittedVersionRequest,
+    Tokens,
+)
+
+VERSIONS_PER_SECOND = 1_000_000
+MAX_VERSION_JUMP = 10 * VERSIONS_PER_SECOND
+
+
+class Master:
+    def __init__(self, first_version: int = 0):
+        self.last_assigned = first_version
+        self.last_assigned_at = 0.0
+        self.live_committed = first_version
+
+    # -- handlers --------------------------------------------------------------
+
+    async def get_commit_version(
+        self, req: GetCommitVersionRequest
+    ) -> GetCommitVersionReply:
+        prev = self.last_assigned
+        t = now()
+        advance = int((t - self.last_assigned_at) * VERSIONS_PER_SECOND)
+        advance = max(1, min(advance, MAX_VERSION_JUMP))
+        self.last_assigned = prev + advance
+        self.last_assigned_at = t
+        return GetCommitVersionReply(prev_version=prev, version=self.last_assigned)
+
+    async def report_committed(self, req: ReportRawCommittedVersionRequest):
+        if req.version > self.live_committed:
+            self.live_committed = req.version
+        return None
+
+    async def get_live_committed(self, _req) -> GetReadVersionReply:
+        return GetReadVersionReply(version=self.live_committed)
+
+    # -- wiring ----------------------------------------------------------------
+
+    def register(self, process) -> None:
+        process.register(Tokens.GET_COMMIT_VERSION, self.get_commit_version)
+        process.register(Tokens.REPORT_COMMITTED, self.report_committed)
+        process.register(Tokens.GET_LIVE_COMMITTED, self.get_live_committed)

@@ -1,0 +1,323 @@
+"""Proxy role: commit batching, the 5-phase commit pipeline, GRV service,
+and key-location queries.
+
+The analog of fdbserver/MasterProxyServer.actor.cpp:
+
+- commit batching (batcher.actor.h): requests accumulate for
+  COMMIT_BATCH_INTERVAL (or MAX_BATCH_TXNS), then run as one batch.
+- commitBatch (:314-873), phases mirrored:
+    1 (:352)  master assigns (prev_version, version) — the global chain
+    2 (:408)  split conflict ranges across resolvers by key partition
+              (ResolutionRequestBuilder:233), resolve, combine verdicts
+    3 (:414)  substitute versionstamps, tag committed mutations per
+              storage team (tagsForKey, :540-580)
+    4 (:800)  push to every tlog, wait for the durability quorum
+    5 (:804)  advance committed version (master report, awaited — this is
+              what makes GRV causally safe), reply per-txn
+- GRV service (transactionStarter:925 / getLiveCommittedVersion:875):
+  batched; returns the master's live committed version.
+- key-location service (readRequestServer:1036) from the static shard map.
+
+Batches are pipelined: phase 1-2 of batch N+1 may run while batch N logs
+(the latestLocalCommitBatchResolving/Logging gates, :353,415); version
+chaining at resolver and tlog keeps application ordered.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..conflict.api import Verdict
+from ..errors import NotCommitted, TransactionTooOld
+from ..kv.keyrange_map import KeyRangeMap
+from ..kv.mutations import Mutation, MutationType
+from ..net.sim import Endpoint
+from ..runtime.futures import Future, delay, wait_for_all, wait_for_any
+from ..runtime.knobs import Knobs
+from .interfaces import (
+    CommitReply,
+    CommitRequest,
+    GetCommitVersionRequest,
+    GetKeyServersReply,
+    GetKeyServersRequest,
+    GetReadVersionReply,
+    GetReadVersionRequest,
+    ReportRawCommittedVersionRequest,
+    ResolveBatchRequest,
+    TLogCommitRequest,
+    Tokens,
+    TransactionData,
+    Version,
+)
+
+
+class ShardMap:
+    """Static key → (team addresses, tags) map; the proxy's keyInfo
+    (ApplyMetadataMutation keeps this live in the reference; static until
+    the data-distribution stage)."""
+
+    def __init__(self):
+        self.map = KeyRangeMap(default=None)  # → (tuple(addresses), tuple(tags))
+
+    def set_shard(self, begin, end, addresses, tags) -> None:
+        self.map.insert(begin, end, (tuple(addresses), tuple(tags)))
+
+    def tags_for_key(self, key: bytes) -> tuple:
+        return self.map[key][1]
+
+    def tags_for_range(self, begin: bytes, end: bytes) -> set:
+        out = set()
+        for _, _, v in self.map.intersecting(begin, end):
+            if v is not None:
+                out.update(v[1])
+        return out
+
+    def team_for_key(self, key: bytes):
+        begin, end, v = self.map.range_for(key)
+        return begin, end, v[0]
+
+
+class Proxy:
+    def __init__(
+        self,
+        master_addr: str,
+        resolver_map: KeyRangeMap,  # key range → resolver endpoint
+        tlog_eps: list,
+        tlog_tags: dict,  # tlog address → frozenset of tags (None = all)
+        shards: ShardMap,
+        knobs: Knobs = None,
+    ):
+        self.master_version_ep = Endpoint(master_addr, Tokens.GET_COMMIT_VERSION)
+        self.master_report_ep = Endpoint(master_addr, Tokens.REPORT_COMMITTED)
+        self.master_live_ep = Endpoint(master_addr, Tokens.GET_LIVE_COMMITTED)
+        self.resolver_map = resolver_map
+        self.tlog_eps = tlog_eps
+        self.tlog_tags = tlog_tags
+        self.shards = shards
+        self.knobs = knobs or Knobs()
+        self.committed_version: Version = 0
+        self.last_resolver_versions: Version = 0
+        self.process = None
+        self._batch: list[tuple[TransactionData, Future]] = []
+        self._batch_trigger: Future = Future()
+        self._work: Future = Future()
+
+    # -- GRV -------------------------------------------------------------------
+
+    async def get_read_version(self, _req: GetReadVersionRequest) -> GetReadVersionReply:
+        # the master's live committed version (reported there before commit
+        # acks reach clients) makes reads causally consistent across proxies
+        live = await self.process.request(self.master_live_ep, None)
+        return GetReadVersionReply(version=live.version)
+
+    # -- key location ----------------------------------------------------------
+
+    async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
+        begin, end, team = self.shards.team_for_key(req.key)
+        return GetKeyServersReply(begin=begin, end=end, team=list(team))
+
+    # -- commit ----------------------------------------------------------------
+
+    async def commit(self, req: CommitRequest) -> CommitReply:
+        done: Future = Future()
+        self._batch.append((req.transaction, done))
+        if len(self._batch) == 1:
+            self._work._set(None)
+        if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
+            self._batch_trigger._set(None)
+        return await done
+
+    async def batcher_loop(self):
+        while True:
+            if not self._batch:
+                self._work = Future()
+                await self._work
+            # batch window: flush on interval or on the size trigger
+            trigger = self._batch_trigger = Future()
+            await wait_for_any([trigger, delay(self.knobs.COMMIT_BATCH_INTERVAL)])
+            batch, self._batch = self._batch, []
+            # commit batches run concurrently (pipelined); version chaining
+            # at resolvers/tlogs orders application
+            self.process.spawn(self.commit_batch(batch))
+
+    async def commit_batch(self, batch):
+        replies = [f for _, f in batch]
+        try:
+            await self._commit_batch(batch)
+        except BaseException as e:
+            # a failed dependency (master/resolver/tlog unreachable) must
+            # error every pending commit, not leave clients hanging; they
+            # see it as commit_unknown_result
+            for f in replies:
+                if not f.is_ready():
+                    f._set_error(e)
+            raise
+
+    async def _commit_batch(self, batch):
+        txns = [t for t, _ in batch]
+        replies = [f for _, f in batch]
+
+        # phase 1: version assignment
+        vreq = await self.process.request(
+            self.master_version_ep, GetCommitVersionRequest()
+        )
+        prev_version, version = vreq.prev_version, vreq.version
+
+        # phase 2: resolution (split per resolver partition)
+        verdicts = await self._resolve(prev_version, version, txns)
+
+        # phase 3: versionstamps + tagging
+        to_log: dict[int, list[Mutation]] = {}
+        stamps: list[bytes] = []
+        for idx, (txn, verdict) in enumerate(zip(txns, verdicts)):
+            stamp = make_versionstamp(version, idx)
+            stamps.append(stamp)
+            if verdict != Verdict.COMMITTED:
+                continue
+            for m in substitute_versionstamps(txn.mutations, stamp):
+                if m.type == MutationType.CLEAR_RANGE:
+                    tags = self.shards.tags_for_range(m.param1, m.param2)
+                else:
+                    tags = self.shards.tags_for_key(m.param1)
+                for tag in tags:
+                    to_log.setdefault(tag, []).append(m)
+
+        # phase 4: push to tlogs. Application order is enforced by the
+        # tlogs' own prev_version chaining, so pushes of successive batches
+        # may be in flight simultaneously (the reference's pipelining).
+        pushes = []
+        for ep in self.tlog_eps:
+            owned = self.tlog_tags.get(ep.address)
+            msgs = (
+                to_log
+                if owned is None
+                else {t: ms for t, ms in to_log.items() if t in owned}
+            )
+            pushes.append(
+                self.process.request(
+                    ep,
+                    TLogCommitRequest(
+                        prev_version=prev_version, version=version, messages=msgs
+                    ),
+                )
+            )
+        await wait_for_all(pushes)
+
+        # phase 5: make the commit visible, then reply. The awaited master
+        # report is what lets any proxy's GRV see this commit (causality).
+        if version > self.committed_version:
+            self.committed_version = version
+        await self.process.request(
+            self.master_report_ep, ReportRawCommittedVersionRequest(version=version)
+        )
+        for verdict, reply, stamp in zip(verdicts, replies, stamps):
+            if verdict == Verdict.COMMITTED:
+                reply._set(CommitReply(version=version, versionstamp=stamp))
+            elif verdict == Verdict.TOO_OLD:
+                reply._set_error(TransactionTooOld())
+            else:
+                reply._set_error(NotCommitted())
+
+    async def _resolve(self, prev_version, version, txns):
+        """ResolutionRequestBuilder (MasterProxyServer.actor.cpp:233): each
+        resolver sees the conflict-range pieces inside its key partition;
+        verdicts combine conservatively (committed iff every involved
+        resolver committed)."""
+        resolvers = {}  # ep.address → (ep, [txn indices], [TransactionData])
+        for r_begin, r_end, ep in self.resolver_map.ranges():
+            resolvers[ep.address] = (ep, r_begin, r_end, [], [])
+
+        single = len(resolvers) == 1
+        for addr, (ep, r_begin, r_end, idxs, datas) in resolvers.items():
+            for i, t in enumerate(txns):
+                if single:
+                    rcr, wcr = t.read_conflict_ranges, t.write_conflict_ranges
+                else:
+                    rcr = _clip_ranges(t.read_conflict_ranges, r_begin, r_end)
+                    wcr = _clip_ranges(t.write_conflict_ranges, r_begin, r_end)
+                if rcr or wcr:
+                    idxs.append(i)
+                    datas.append(
+                        TransactionData(
+                            read_snapshot=t.read_snapshot,
+                            read_conflict_ranges=rcr,
+                            write_conflict_ranges=wcr,
+                        )
+                    )
+
+        verdicts = [Verdict.COMMITTED] * len(txns)
+        reqs, meta = [], []
+        for addr, (ep, _b, _e, idxs, datas) in resolvers.items():
+            # every resolver sees every version to keep its chain advancing,
+            # even with no transactions for it (Resolver.actor.cpp:104-122)
+            reqs.append(
+                self.process.request(
+                    ep,
+                    ResolveBatchRequest(
+                        prev_version=prev_version,
+                        version=version,
+                        last_receive_version=self.last_resolver_versions,
+                        requesting_proxy=self.process.address,
+                        transactions=datas,
+                    ),
+                )
+            )
+            meta.append(idxs)
+        self.last_resolver_versions = version
+        replies = await wait_for_all(reqs)
+        for idxs, reply in zip(meta, replies):
+            for i, v in zip(idxs, reply.committed):
+                verdicts[i] = max(verdicts[i], Verdict(v))  # CONFLICT/TOO_OLD win
+        return verdicts
+
+    # -- wiring ----------------------------------------------------------------
+
+    def register(self, process) -> None:
+        self.process = process
+        process.register(Tokens.GRV, self.get_read_version)
+        process.register(Tokens.COMMIT, self.commit)
+        process.register(Tokens.GET_KEY_SERVERS, self.get_key_servers)
+        process.spawn(self.batcher_loop())
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _clip_ranges(ranges, begin: bytes, end) -> list:
+    out = []
+    for b, e in ranges:
+        cb = max(b, begin)
+        ce = e if end is None else min(e, end)
+        if cb < ce:
+            out.append((cb, ce))
+    return out
+
+
+def make_versionstamp(version: Version, batch_index: int) -> bytes:
+    """10 bytes: 8-byte big-endian commit version + 2-byte batch order —
+    the reference's versionstamp format (fdbclient/CommitTransaction.h)."""
+    return struct.pack(">QH", version, batch_index)
+
+
+def substitute_versionstamps(mutations, stamp: bytes):
+    """Rewrite SET_VERSIONSTAMPED_KEY/VALUE to plain sets, patching the
+    stamp in at the 4-byte little-endian offset trailing the parameter
+    (the bindings' versionstamp convention)."""
+    out = []
+    for m in mutations:
+        if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+            key = _patch(m.param1, stamp)
+            out.append(Mutation(MutationType.SET_VALUE, key, m.param2))
+        elif m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+            val = _patch(m.param2, stamp)
+            out.append(Mutation(MutationType.SET_VALUE, m.param1, val))
+        else:
+            out.append(m)
+    return out
+
+
+def _patch(param: bytes, stamp: bytes) -> bytes:
+    pos = struct.unpack("<I", param[-4:])[0]
+    body = param[:-4]
+    assert pos + 10 <= len(body), "versionstamp offset out of range"
+    return body[:pos] + stamp + body[pos + 10 :]

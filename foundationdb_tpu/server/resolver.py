@@ -1,0 +1,81 @@
+"""Resolver role: ordered MVCC conflict detection over a ConflictSet backend.
+
+The analog of fdbserver/Resolver.actor.cpp (resolveBatch:71-260). The two
+essential mechanisms, mirrored:
+
+- **prev_version chaining** (Resolver.actor.cpp:104-122): commit batches from
+  any number of proxies are applied in one global version order by waiting
+  until the resolver's version equals the batch's prev_version. The master's
+  (prev, version) pairs form a linked list over batches; no other
+  coordination is needed.
+- **reply caching** (outstandingBatches:159): a proxy may retransmit a batch
+  it never heard back about; resolution is not idempotent (committed writes
+  entered the history), so replies are cached by version and replayed.
+
+The conflict check itself is the pluggable ConflictSet seam
+(conflict/api.py): "oracle" in small sims, "native" C++ skip list, or the
+"tpu" vectorized interval kernel.
+"""
+
+from __future__ import annotations
+
+from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
+from ..runtime.futures import VersionGate
+from ..runtime.knobs import Knobs
+from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
+
+
+class Resolver:
+    def __init__(self, knobs: Knobs = None, backend: str = "oracle", **backend_kw):
+        self.knobs = knobs or Knobs()
+        self.cs = new_conflict_set(backend, **backend_kw)
+        self.gate = VersionGate(0)
+        self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
+        self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
+
+    @property
+    def version(self) -> Version:
+        return self.gate.version
+
+    async def resolve(self, req: ResolveBatchRequest) -> ResolveBatchReply:
+        if req.version in self._replies:
+            return self._replies[req.version]
+        # ordered application: wait for our turn in the version chain
+        await self.gate.wait_until(req.prev_version)
+        if req.version in self._replies:  # resolved while waiting (dup)
+            return self._replies[req.version]
+        if req.prev_version < self.gate.version:
+            # stale retransmit of an already-superseded batch with no cached
+            # reply: everything in it lost (proxy will have failed anyway)
+            return ResolveBatchReply(
+                committed=[Verdict.CONFLICT] * len(req.transactions)
+            )
+
+        txns = [
+            CommitTransaction(
+                read_snapshot=t.read_snapshot,
+                read_conflict_ranges=t.read_conflict_ranges,
+                write_conflict_ranges=t.write_conflict_ranges,
+            )
+            for t in req.transactions
+        ]
+        window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
+        verdicts = self.cs.detect_batch(
+            txns, now=req.version, new_oldest_version=max(0, req.version - window)
+        )
+        reply = ResolveBatchReply(committed=[int(v) for v in verdicts])
+
+        self._replies[req.version] = reply
+        # retire cached replies once EVERY proxy has moved past them — one
+        # proxy's progress must not delete another's retransmit window
+        if req.requesting_proxy:
+            self._proxy_lrv[req.requesting_proxy] = req.last_receive_version
+            horizon = min(self._proxy_lrv.values())
+            for v in [v for v in self._replies if v < horizon]:
+                del self._replies[v]
+
+        self.gate.advance_to(req.version)
+        return reply
+
+    def register(self, process) -> None:
+        process.register(Tokens.RESOLVE, self.resolve)

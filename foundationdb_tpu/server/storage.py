@@ -1,0 +1,118 @@
+"""Storage server role: the MVCC read node.
+
+The analog of fdbserver/storageserver.actor.cpp: pulls its tag's mutation
+stream from the tlog (update:2321), applies it in version order to the
+VersionedMap MVCC window, serves version-gated reads (getValueQ:680,
+getKeyValues:1180, waitForVersion:627), and periodically advances durability
+— here, compacting the window and popping the tlog (updateStorage:2536).
+"""
+
+from __future__ import annotations
+
+from ..errors import FutureVersion, TransactionTooOld
+from ..kv.atomic import apply_atomic
+from ..kv.mutations import MutationType
+from ..kv.versioned_map import VersionedMap
+from ..runtime.futures import AsyncVar, delay, wait_for_any
+from ..runtime.knobs import Knobs
+from ..runtime.trace import SevInfo, trace
+from .interfaces import (
+    GetKeyValuesReply,
+    GetKeyValuesRequest,
+    GetValueReply,
+    GetValueRequest,
+    TLogPeekRequest,
+    TLogPopRequest,
+    Tokens,
+    Version,
+)
+
+WAIT_FOR_VERSION_TIMEOUT = 1.0  # then future_version (client retries the read)
+
+
+class StorageServer:
+    def __init__(self, tag: int, tlog_ep, knobs: Knobs = None):
+        self.tag = tag
+        self.tlog_ep = tlog_ep
+        self.knobs = knobs or Knobs()
+        self.data = VersionedMap()
+        self.version = AsyncVar(0)
+        self.durable_version = 0
+        self.process = None
+
+    # -- mutation pull loop (update:2321) --------------------------------------
+
+    async def pull_loop(self):
+        while True:
+            req = TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1)
+            reply = await self.process.request(self.tlog_ep, req)
+            for version, mutations in reply.messages:
+                for m in mutations:
+                    self._apply(m, version)
+            if reply.end_version > self.version.get():
+                self.version.set(reply.end_version)
+
+    def _apply(self, m, version: Version) -> None:
+        if m.type == MutationType.SET_VALUE:
+            self.data.set(m.param1, m.param2, version)
+        elif m.type == MutationType.CLEAR_RANGE:
+            self.data.clear_range(m.param1, m.param2, version)
+        elif m.is_atomic():
+            newv = apply_atomic(m.type, self.data.latest(m.param1), m.param2)
+            if newv is None:
+                self.data.clear_range(m.param1, m.param1 + b"\x00", version)
+            else:
+                self.data.set(m.param1, newv, version)
+        else:
+            raise AssertionError(f"storage can't apply {m!r}")
+
+    # -- durability / window advance (updateStorage:2536) ----------------------
+
+    async def durability_loop(self):
+        while True:
+            await delay(self.knobs.STORAGE_DURABILITY_LAG)
+            new_durable = max(
+                0,
+                self.version.get() - self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS,
+            )
+            if new_durable > self.durable_version:
+                self.durable_version = new_durable
+                self.data.forget_before(new_durable)
+                await self.process.request(
+                    self.tlog_ep, TLogPopRequest(tag=self.tag, upto=self.version.get())
+                )
+
+    # -- version gate (waitForVersion:627) -------------------------------------
+
+    async def _wait_for_version(self, version: Version):
+        if version < self.data.oldest_version:
+            raise TransactionTooOld()
+        deadline = delay(WAIT_FOR_VERSION_TIMEOUT)
+        while self.version.get() < version:
+            which = await wait_for_any([self.version.on_change(), deadline])
+            if which == 1:
+                raise FutureVersion()
+
+    # -- reads -----------------------------------------------------------------
+
+    async def get_value(self, req: GetValueRequest) -> GetValueReply:
+        await self._wait_for_version(req.version)
+        return GetValueReply(value=self.data.get(req.key, req.version))
+
+    async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
+        await self._wait_for_version(req.version)
+        data = self.data.range(
+            req.begin, req.end, req.version, limit=req.limit + 1, reverse=req.reverse
+        )
+        more = len(data) > req.limit
+        return GetKeyValuesReply(data=data[: req.limit], more=more)
+
+    # -- wiring ----------------------------------------------------------------
+
+    def register(self, process) -> None:
+        self.process = process
+        process.register(Tokens.GET_VALUE, self.get_value)
+        process.register(Tokens.GET_KEY_VALUES, self.get_key_values)
+        process.spawn(self.pull_loop())
+        process.spawn(self.durability_loop())
+        trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)

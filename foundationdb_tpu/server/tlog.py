@@ -1,0 +1,101 @@
+"""TLog role: the durable, tag-indexed write-ahead log.
+
+The analog of fdbserver/TLogServer.actor.cpp: commits arrive in version order
+(prev_version chaining, like the resolver — tLogCommit:1115 waits on the same
+kind of sequencing), are indexed by tag in memory (LogData:304), and are
+served to storage servers as per-tag streams (tLogPeekMessages:903) with
+long-polling; acked data is trimmed by pop (tLogPop:861).
+
+Durability here is modeled (a simulated fsync delay before the ack — the
+DiskQueue push+sync of doQueueCommit:1045); the native DiskQueue-backed
+persistence joins with the storage-engine stage (SURVEY.md §7 stage 7).
+"""
+
+from __future__ import annotations
+
+import bisect
+from ..runtime.futures import AsyncVar, VersionGate, delay
+from ..runtime.knobs import Knobs
+from .interfaces import (
+    TLogCommitRequest,
+    TLogPeekReply,
+    TLogPeekRequest,
+    TLogPopRequest,
+    Tokens,
+    Version,
+)
+
+FSYNC_TIME = 0.0005  # simulated DiskQueue sync
+
+
+class TLog:
+    def __init__(self, knobs: Knobs = None, tags: frozenset = None):
+        self.knobs = knobs or Knobs()
+        self.tags = tags  # tags this tlog stores; None = all
+        # ascending [(version, {tag: [mutations]})]
+        self._log: list[tuple[Version, dict]] = []
+        self._versions: list[Version] = []  # parallel index for bisect
+        self.version = AsyncVar(0)  # highest *durable* (fsynced) version
+        self._gate = VersionGate(0)  # commit sequencing
+        self._popped: dict[int, Version] = {}  # tag → popped-through version
+
+    async def commit(self, req: TLogCommitRequest):
+        # version-ordered application (same chain discipline as the resolver)
+        await self._gate.wait_until(req.prev_version)
+        if req.version <= self._gate.version:
+            return None  # duplicate commit (proxy retry) — already durable
+        msgs = {
+            t: ms
+            for t, ms in req.messages.items()
+            if ms and (self.tags is None or t in self.tags)
+        }
+        if msgs:
+            self._log.append((req.version, msgs))
+            self._versions.append(req.version)
+        await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
+        self._gate.advance_to(req.version)
+        if req.version > self.version.get():
+            self.version.set(req.version)
+        return None
+
+    async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
+        # long-poll: wait until data through req.begin exists
+        while self.version.get() < req.begin:
+            await self.version.on_change()
+        durable = self.version.get()
+        i = bisect.bisect_left(self._versions, req.begin)
+        # clamp at the durable horizon: entries appended but not yet fsynced
+        # must not be served (a peeker would double-apply them next poll)
+        hi = bisect.bisect_right(self._versions, durable)
+        out = []
+        for v, msgs in self._log[i:hi]:
+            if req.tag in msgs:
+                out.append((v, msgs[req.tag]))
+        return TLogPeekReply(messages=out, end_version=durable)
+
+    async def pop(self, req: TLogPopRequest):
+        prev = self._popped.get(req.tag, 0)
+        if req.upto > prev:
+            self._popped[req.tag] = req.upto
+            self._trim()
+        return None
+
+    def _trim(self) -> None:
+        """Drop log entries every tag has popped past (reference: DiskQueue
+        pop location advancing once all tags acknowledge)."""
+        if not self._log:
+            return
+        # a tag with data but no pop record pins the log
+        live_tags = set()
+        for _, msgs in self._log:
+            live_tags.update(msgs)
+        horizon = min((self._popped.get(t, 0) for t in live_tags), default=0)
+        i = bisect.bisect_right(self._versions, horizon)
+        if i:
+            del self._log[:i]
+            del self._versions[:i]
+
+    def register(self, process) -> None:
+        process.register(Tokens.TLOG_COMMIT, self.commit)
+        process.register(Tokens.TLOG_PEEK, self.peek)
+        process.register(Tokens.TLOG_POP, self.pop)

@@ -1,0 +1,336 @@
+"""End-to-end transaction path in simulation: client → proxy → master/
+resolver → tlog → storage and back.
+
+The milestone test of SURVEY.md §7 stage 4 (the single-process vertical
+slice, here as simulated multi-process roles). Each test builds a seeded
+cluster; everything is deterministic from the seed.
+"""
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.errors import NotCommitted
+from foundationdb_tpu.kv.mutations import MutationType as MT
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn, wait_for_all
+from foundationdb_tpu.server import Cluster, ClusterConfig
+
+
+def make_db(seed=0, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(**cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def drive(sim, coro, limit=120.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+# -- basic read/write ---------------------------------------------------------
+
+
+def test_set_commit_get():
+    sim, cluster, db = make_db()
+
+    async def go():
+        tr = db.transaction()
+        tr.set(b"hello", b"world")
+        v = await tr.commit()
+        assert v > 0
+        tr2 = db.transaction()
+        got = await tr2.get(b"hello")
+        assert got == b"world"
+        assert await tr2.get(b"missing") is None
+        return True
+
+    assert drive(sim, go())
+
+
+def test_read_your_writes_before_commit():
+    sim, cluster, db = make_db(seed=1)
+
+    async def go():
+        tr0 = db.transaction()
+        tr0.set(b"a", b"committed")
+        tr0.set(b"gone", b"x")
+        await tr0.commit()
+
+        tr = db.transaction()
+        # overlay over storage
+        assert await tr.get(b"a") == b"committed"
+        tr.set(b"a", b"mine")
+        assert await tr.get(b"a") == b"mine"
+        tr.clear(b"gone")
+        assert await tr.get(b"gone") is None
+        # atomic over unknown base resolves through storage
+        tr.atomic_op(MT.APPEND_IF_FITS, b"a", b"!")
+        assert await tr.get(b"a") == b"mine!"
+        tr.atomic_op(MT.ADD, b"ctr", b"\x05")
+        assert await tr.get(b"ctr") == b"\x05"
+        await tr.commit()
+
+        tr2 = db.transaction()
+        assert await tr2.get(b"a") == b"mine!"
+        assert await tr2.get(b"gone") is None
+        assert await tr2.get(b"ctr") == b"\x05"
+        return True
+
+    assert drive(sim, go())
+
+
+def test_conflict_detection_end_to_end():
+    sim, cluster, db = make_db(seed=2)
+
+    async def go():
+        setup = db.transaction()
+        setup.set(b"k", b"0")
+        await setup.commit()
+
+        a = db.transaction()
+        b = db.transaction()
+        va = await a.get(b"k")
+        vb = await b.get(b"k")
+        a.set(b"k", b"a")
+        b.set(b"k", b"b")
+        await a.commit()
+        with pytest.raises(NotCommitted):
+            await b.commit()
+        # non-overlapping writes with non-overlapping reads both commit
+        c = db.transaction()
+        d = db.transaction()
+        await c.get(b"c-key")
+        await d.get(b"d-key")
+        c.set(b"c-key", b"1")
+        d.set(b"d-key", b"1")
+        await c.commit()
+        await d.commit()
+        return True
+
+    assert drive(sim, go())
+
+
+def test_blind_writes_never_conflict():
+    sim, cluster, db = make_db(seed=3)
+
+    async def go():
+        trs = [db.transaction() for _ in range(8)]
+        for i, tr in enumerate(trs):
+            tr.set(b"same-key", b"%d" % i)
+        await wait_for_all([spawn(tr.commit()) for tr in trs])
+        tr = db.transaction()
+        assert await tr.get(b"same-key") is not None
+        return True
+
+    assert drive(sim, go())
+
+
+def test_causal_consistency_across_transactions():
+    """A committed write is visible to any later-started transaction
+    (GRV ≥ commit version — the getLiveCommittedVersion guarantee)."""
+    sim, cluster, db = make_db(seed=4, n_proxies=2)
+
+    async def go():
+        for i in range(20):
+            tr = db.transaction()
+            tr.set(b"seq", b"%03d" % i)
+            await tr.commit()
+            tr2 = db.transaction()  # may hit the other proxy
+            assert await tr2.get(b"seq") == b"%03d" % i
+        return True
+
+    assert drive(sim, go())
+
+
+# -- ranges -------------------------------------------------------------------
+
+
+def test_range_reads_and_clear_range():
+    sim, cluster, db = make_db(seed=5)
+
+    async def go():
+        tr = db.transaction()
+        for i in range(10):
+            tr.set(b"r/%02d" % i, b"v%d" % i)
+        await tr.commit()
+
+        tr = db.transaction()
+        rows = await tr.get_range(b"r/", b"r0")
+        assert [k for k, _ in rows] == [b"r/%02d" % i for i in range(10)]
+        rows = await tr.get_range(b"r/", b"r0", limit=3)
+        assert len(rows) == 3
+        rows = await tr.get_range(b"r/", b"r0", limit=2, reverse=True)
+        assert [k for k, _ in rows] == [b"r/09", b"r/08"]
+
+        tr.clear_range(b"r/03", b"r/07")
+        tr.set(b"r/05", b"resurrected")
+        rows = await tr.get_range(b"r/", b"r0")
+        assert [k for k, _ in rows] == [
+            b"r/00", b"r/01", b"r/02", b"r/05", b"r/07", b"r/08", b"r/09",
+        ]
+        assert dict(rows)[b"r/05"] == b"resurrected"
+        await tr.commit()
+
+        tr = db.transaction()
+        rows = await tr.get_range(b"r/", b"r0")
+        assert [k for k, _ in rows] == [
+            b"r/00", b"r/01", b"r/02", b"r/05", b"r/07", b"r/08", b"r/09",
+        ]
+        return True
+
+    assert drive(sim, go())
+
+
+def test_range_conflict():
+    """A range read conflicts with a later write inside the range."""
+    sim, cluster, db = make_db(seed=6)
+
+    async def go():
+        a = db.transaction()
+        await a.get_range(b"q/", b"q0")
+        a.set(b"q/result", b"empty")
+
+        b = db.transaction()
+        b.set(b"q/item", b"new")
+        await b.commit()
+
+        with pytest.raises(NotCommitted):
+            await a.commit()
+        return True
+
+    assert drive(sim, go())
+
+
+# -- versionstamps ------------------------------------------------------------
+
+
+def test_versionstamped_key():
+    import struct
+
+    sim, cluster, db = make_db(seed=7)
+
+    async def go():
+        tr = db.transaction()
+        placeholder = b"log/" + b"\x00" * 10
+        tr.set_versionstamped_key(
+            placeholder + struct.pack("<I", 4), b"entry-1"
+        )
+        v = await tr.commit()
+        stamp = tr.get_versionstamp()
+        assert struct.unpack(">Q", stamp[:8])[0] == v
+
+        tr2 = db.transaction()
+        rows = await tr2.get_range(b"log/", b"log0")
+        assert len(rows) == 1
+        assert rows[0][0] == b"log/" + stamp
+        assert rows[0][1] == b"entry-1"
+        return True
+
+    assert drive(sim, go())
+
+
+# -- scaled shapes ------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        dict(n_proxies=2, n_resolvers=2, n_tlogs=2, n_storage=2),
+        dict(n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=2, replication=2),
+        dict(n_proxies=3, n_resolvers=2, n_tlogs=2, n_storage=4, replication=2),
+    ],
+)
+def test_cluster_shapes(shape):
+    sim, cluster, db = make_db(seed=8, **shape)
+
+    async def go():
+        # writes spanning the whole keyspace (all shards/resolvers)
+        tr = db.transaction()
+        for first in (0x00, 0x40, 0x80, 0xC0, 0xFF):
+            tr.set(bytes([first]) + b"key", b"v%d" % first)
+        await tr.commit()
+        tr = db.transaction()
+        for first in (0x00, 0x40, 0x80, 0xC0, 0xFF):
+            assert await tr.get(bytes([first]) + b"key") == b"v%d" % first
+        rows = await tr.get_range(b"", b"\xff\xff")
+        assert len(rows) == 5
+        # cross-shard conflicts still detected
+        a = db.transaction()
+        await a.get(b"\x00key")
+        a.set(b"\xc0key", b"a")
+        b = db.transaction()
+        b.set(b"\x00key", b"b")
+        await b.commit()
+        with pytest.raises(NotCommitted):
+            await a.commit()
+        return True
+
+    assert drive(sim, go())
+
+
+def test_replicas_converge():
+    """With replication=2 both team members end up with identical data
+    (the ConsistencyCheck invariant)."""
+    sim, cluster, db = make_db(seed=9, n_storage=2, replication=2)
+
+    async def go():
+        for i in range(10):
+            tr = db.transaction()
+            tr.set(b"c/%d" % i, b"v%d" % i)
+            await tr.commit()
+        return True
+
+    assert drive(sim, go())
+    # drain: run sim forward so both replicas pull everything
+    sim.run(until=sim.loop.now() + 5.0)
+    s0, s1 = cluster.storages
+    v = min(s0.version.get(), s1.version.get())
+    assert s0.data.range(b"", b"\xff", v) == s1.data.range(b"", b"\xff", v)
+    assert len(s0.data.range(b"", b"\xff", v)) == 10
+
+
+# -- regressions from review --------------------------------------------------
+
+
+def test_range_limit_with_overlay_clears():
+    """A truncated storage reply must not end the range early: clearing the
+    first rows and reading with a small limit still yields later keys."""
+    sim, cluster, db = make_db(seed=10)
+
+    async def go():
+        tr = db.transaction()
+        for i in range(10):
+            tr.set(b"w/%02d" % i, b"v%d" % i)
+        await tr.commit()
+
+        tr = db.transaction()
+        tr.clear_range(b"w/00", b"w/04")
+        rows = await tr.get_range(b"w/", b"w0", limit=5)
+        assert [k for k, _ in rows] == [b"w/04", b"w/05", b"w/06", b"w/07", b"w/08"]
+        # pending atomic on a key beyond the first storage window still
+        # sees its true base value
+        tr.atomic_op(MT.APPEND_IF_FITS, b"w/09", b"+")
+        rows = await tr.get_range(b"w/", b"w0", limit=6)
+        assert rows[-1] == (b"w/09", b"v9+")
+        return True
+
+    assert drive(sim, go())
+
+
+def test_atomic_adds_apply_exactly_once():
+    """Counter increments across many txns sum exactly (would fail if the
+    tlog served unsynced entries and storage double-applied them)."""
+    sim, cluster, db = make_db(seed=11)
+
+    async def go():
+        n = 30
+        for _ in range(n):
+            tr = db.transaction()
+            tr.atomic_op(MT.ADD, b"counter", b"\x01\x00")
+            await tr.commit()
+        tr = db.transaction()
+        assert await tr.get(b"counter") == bytes([n, 0])
+        return True
+
+    assert drive(sim, go())

@@ -28,7 +28,7 @@ def test_add_little_endian():
 
 def test_bitwise():
     assert apply_atomic(MT.AND, b"\x0f", b"\x3c") == b"\x0c"
-    assert apply_atomic(MT.AND, None, b"\xff") == b"\x00"  # absent-as-zero
+    assert apply_atomic(MT.AND, None, b"\xff") == b"\xff"  # doAndV2: absent → operand
     assert apply_atomic(MT.OR, b"\x0f", b"\x30") == b"\x3f"
     assert apply_atomic(MT.XOR, b"\xff", b"\x0f") == b"\xf0"
 
