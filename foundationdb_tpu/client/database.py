@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..net.sim import Endpoint, Sim
+from ..net.sim import BrokenPromise, Endpoint, Sim
+from ..runtime.futures import delay
 from ..runtime.knobs import Knobs
 from ..kv.keyrange_map import KeyRangeMap
 from ..server.interfaces import GetKeyServersRequest, Tokens
@@ -29,9 +30,22 @@ class Database:
 
     # -- routing ---------------------------------------------------------------
 
-    def _proxy_request(self, token: str, req):
-        addr = self.rng.random_choice(self.proxy_addrs)
-        return self.client.request(Endpoint(addr, token), req)
+    async def _proxy_request(self, token: str, req, retry: bool = True):
+        """RPC to some proxy. Safe-to-retry requests (GRV, key location)
+        fail over across proxies; non-idempotent ones (commit) surface
+        BrokenPromise to the caller, which maps it to commit_unknown_result."""
+        if not retry:
+            addr = self.rng.random_choice(self.proxy_addrs)
+            return await self.client.request(Endpoint(addr, token), req)
+        last_err = None
+        for attempt in range(3 * max(1, len(self.proxy_addrs))):
+            addr = self.rng.random_choice(self.proxy_addrs)
+            try:
+                return await self.client.request(Endpoint(addr, token), req)
+            except BrokenPromise as e:
+                last_err = e
+                await delay(0.05 * (attempt + 1))
+        raise last_err
 
     async def _locate(self, key: bytes):
         """(shard begin, end, team) for key, cached (NativeAPI:1059)."""

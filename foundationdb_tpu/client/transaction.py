@@ -160,10 +160,12 @@ class Transaction:
         base = await self._storage_get(key)
         if w is None:
             return base
-        # pending atomic chain over the storage base
+        # pending atomic chain over the storage base; collapse to a
+        # determined value so repeat reads skip the storage round-trip
         v = base
         for op, param in w[1]:
             v = apply_atomic(op, v, param)
+        self._writes[key] = ("value", v)
         return v
 
     async def get_range(
@@ -175,6 +177,11 @@ class Transaction:
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
         assert not reverse or limit < (1 << 30), "reverse needs a limit"
+        for body in self._unreadable:
+            if begin <= body < end:
+                # a pending versionstamped write will land somewhere in this
+                # range; its final key is unknowable before commit
+                raise AccessedUnreadable()
         out = await self._get_range_merged(begin, end, limit, reverse)
         if not snapshot:
             # conflict on the portion actually observed (NativeAPI clamps
@@ -318,7 +325,7 @@ class Transaction:
         )
         try:
             reply = await self.db._proxy_request(
-                Tokens.COMMIT, CommitRequest(transaction=data)
+                Tokens.COMMIT, CommitRequest(transaction=data), retry=False
             )
         except (NotCommitted, TransactionTooOld):
             raise

@@ -132,9 +132,11 @@ class Proxy:
             if not self._batch:
                 self._work = Future()
                 await self._work
-            # batch window: flush on interval or on the size trigger
-            trigger = self._batch_trigger = Future()
-            await wait_for_any([trigger, delay(self.knobs.COMMIT_BATCH_INTERVAL)])
+            # batch window: flush on interval or on the size trigger (which
+            # may already have fired while we were parked on _work)
+            if len(self._batch) < self.knobs.MAX_BATCH_TXNS:
+                trigger = self._batch_trigger = Future()
+                await wait_for_any([trigger, delay(self.knobs.COMMIT_BATCH_INTERVAL)])
             batch, self._batch = self._batch, []
             # commit batches run concurrently (pipelined); version chaining
             # at resolvers/tlogs orders application

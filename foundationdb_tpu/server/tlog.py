@@ -37,13 +37,17 @@ class TLog:
         self._versions: list[Version] = []  # parallel index for bisect
         self.version = AsyncVar(0)  # highest *durable* (fsynced) version
         self._gate = VersionGate(0)  # commit sequencing
+        self._pending: set[Version] = set()  # appended, fsync in progress
         self._popped: dict[int, Version] = {}  # tag → popped-through version
 
     async def commit(self, req: TLogCommitRequest):
         # version-ordered application (same chain discipline as the resolver)
         await self._gate.wait_until(req.prev_version)
-        if req.version <= self._gate.version:
-            return None  # duplicate commit (proxy retry) — already durable
+        if req.version <= self._gate.version or req.version in self._pending:
+            # duplicate (proxy retransmit): already durable, or appended and
+            # mid-fsync — a second append would double-apply at storage
+            return None
+        self._pending.add(req.version)
         msgs = {
             t: ms
             for t, ms in req.messages.items()
@@ -53,6 +57,7 @@ class TLog:
             self._log.append((req.version, msgs))
             self._versions.append(req.version)
         await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
+        self._pending.discard(req.version)
         self._gate.advance_to(req.version)
         if req.version > self.version.get():
             self.version.set(req.version)

@@ -1,0 +1,53 @@
+"""Sideband workload: external (causal) consistency.
+
+The analog of fdbserver/workloads/Sideband.actor.cpp: a mutator commits a
+key, then tells a checker out-of-band. The checker's subsequently-started
+transaction MUST see the key — if its GRV could lag the reported commit
+version, causality is broken (the getLiveCommittedVersion guarantee the
+proxy/master pair provides).
+"""
+
+from __future__ import annotations
+
+from ..runtime.futures import PromiseStream, StreamClosed
+from . import Workload
+
+
+class SidebandWorkload(Workload):
+    def __init__(self, db, rng, messages=25, prefix=b"sideband/", **kw):
+        super().__init__(db, rng, **kw)
+        self.messages = messages
+        self.prefix = prefix
+        self.stream: PromiseStream = PromiseStream()
+        self.checked = 0
+
+    async def _mutator(self):
+        for i in range(self.messages):
+            tr = self.db.transaction()
+            tr.set(self.prefix + b"%04d" % i, b"sent")
+            version = await tr.commit()
+            self.stream.send((i, version))
+        self.stream.close()
+
+    async def _checker(self):
+        while True:
+            try:
+                i, version = await self.stream.next()
+            except StreamClosed:
+                return
+            tr = self.db.transaction()
+            got = await tr.get(self.prefix + b"%04d" % i)
+            assert got == b"sent", (
+                f"causality violation: message {i} committed at {version} "
+                f"but invisible at read version {tr._read_version}"
+            )
+            assert tr._read_version >= version
+            self.checked += 1
+
+    async def start(self):
+        from ..runtime.futures import spawn, wait_for_all
+
+        await wait_for_all([spawn(self._mutator()), spawn(self._checker())])
+
+    async def check(self) -> bool:
+        return self.checked == self.messages
