@@ -110,8 +110,10 @@ class Transaction:
             cur = ("value", None)
         if cur is None:
             self._writes[key] = ("ops", [(op, param)])
-        elif cur[0] == "value":
-            self._writes[key] = ("value", apply_atomic(op, cur[1], param))
+        elif cur[0] in ("value", "value_db"):
+            # chaining onto a determined value preserves its provenance
+            # (database-dependent values stay conflict-protected on read)
+            self._writes[key] = (cur[0], apply_atomic(op, cur[1], param))
         else:
             self._writes[key] = ("ops", cur[1] + [(op, param)])
         self._mutations.append(Mutation(op, key, param))
@@ -147,11 +149,17 @@ class Transaction:
             raise AccessedUnreadable()
         w = self._writes.get(key)
         if w is not None and w[0] == "value":
-            # fully determined by this txn's writes: no storage read, and —
-            # matching the reference — still a read conflict (the value
-            # "read" depends on what this txn observed)... except a plain
-            # overwrite never observed the database. RYW reads of our own
-            # sets add no conflict range (ReadYourWrites 'read from write').
+            # fully determined by this txn's own writes: no storage read and
+            # no read conflict (ReadYourWrites 'read from write' — a plain
+            # overwrite never observed the database)
+            return w[1]
+        if w is not None and w[0] == "value_db":
+            # determined, but by collapsing an atomic chain over a value
+            # observed from the database: repeat reads skip the storage
+            # round-trip, yet a non-snapshot read still depends on the base
+            # value and must conflict-protect it
+            if not snapshot:
+                self._rcr.append((key, key_after(key)))
             return w[1]
         if not snapshot:
             self._rcr.append((key, key_after(key)))
@@ -161,11 +169,11 @@ class Transaction:
         if w is None:
             return base
         # pending atomic chain over the storage base; collapse to a
-        # determined value so repeat reads skip the storage round-trip
+        # determined-but-database-dependent value
         v = base
         for op, param in w[1]:
             v = apply_atomic(op, v, param)
-        self._writes[key] = ("value", v)
+        self._writes[key] = ("value_db", v)
         return v
 
     async def get_range(
@@ -231,7 +239,7 @@ class Transaction:
                 merged[k] = v
         for k, w in self._writes.items():
             if lo <= k < hi:
-                if w[0] == "value":
+                if w[0] in ("value", "value_db"):
                     v = w[1]
                 else:
                     v = merged.get(k)  # absent in window = absent in storage

@@ -282,6 +282,39 @@ class TimedOut(Exception):
     pass
 
 
+class QuorumFailed(Exception):
+    """Too many of a quorum's futures failed for it ever to succeed."""
+
+
+def quorum(futures: list[Future], n: int) -> Future[list]:
+    """Resolves once ``n`` of the futures succeed (flow's ``quorum()``),
+    with the successful results (order of completion). Errors with
+    QuorumFailed as soon as success becomes impossible."""
+    out: Future[list] = Future()
+    successes: list = []
+    fails = [0]
+    total = len(futures)
+    if n > total:
+        out._set_error(QuorumFailed(f"need {n} of {total}"))
+        return out
+
+    def cb(f: Future):
+        if out.is_ready():
+            return
+        if f._error is not None:
+            fails[0] += 1
+            if total - fails[0] < n:
+                out._set_error(QuorumFailed(f"{fails[0]}/{total} failed, need {n}"))
+        else:
+            successes.append(f._value)
+            if len(successes) >= n:
+                out._set(list(successes))
+
+    for f in futures:
+        f.add_callback(cb)
+    return out
+
+
 async def timeout(fut: Future[T], seconds: float, default=None) -> T:
     timer = delay(seconds)
     which = await wait_for_any([fut, timer])

@@ -14,7 +14,7 @@ persistence joins with the storage-engine stage (SURVEY.md §7 stage 7).
 from __future__ import annotations
 
 import bisect
-from ..runtime.futures import AsyncVar, VersionGate, delay
+from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from .interfaces import (
     TLogCommitRequest,
@@ -37,27 +37,45 @@ class TLog:
         self._versions: list[Version] = []  # parallel index for bisect
         self.version = AsyncVar(0)  # highest *durable* (fsynced) version
         self._gate = VersionGate(0)  # commit sequencing
-        self._pending: set[Version] = set()  # appended, fsync in progress
+        # version → durability future while an append+fsync is in flight;
+        # duplicates await it instead of acking early
+        self._pending: dict[Version, Future] = {}
         self._popped: dict[int, Version] = {}  # tag → popped-through version
 
     async def commit(self, req: TLogCommitRequest):
         # version-ordered application (same chain discipline as the resolver)
         await self._gate.wait_until(req.prev_version)
-        if req.version <= self._gate.version or req.version in self._pending:
-            # duplicate (proxy retransmit): already durable, or appended and
-            # mid-fsync — a second append would double-apply at storage
+        if req.version <= self._gate.version:
+            return None  # duplicate (proxy retransmit): already durable
+        dup = self._pending.get(req.version)
+        if dup is not None:
+            # appended and mid-fsync: a second append would double-apply at
+            # storage, but acking now would claim durability that doesn't
+            # exist yet — wait for the original's fsync
+            await dup
             return None
-        self._pending.add(req.version)
-        msgs = {
-            t: ms
-            for t, ms in req.messages.items()
-            if ms and (self.tags is None or t in self.tags)
-        }
-        if msgs:
-            self._log.append((req.version, msgs))
-            self._versions.append(req.version)
-        await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
-        self._pending.discard(req.version)
+        durable = self._pending[req.version] = Future()
+        try:
+            msgs = {
+                t: ms
+                for t, ms in req.messages.items()
+                if ms and (self.tags is None or t in self.tags)
+            }
+            if msgs:
+                self._log.append((req.version, msgs))
+                self._versions.append(req.version)
+            await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
+            durable._set(None)
+        finally:
+            # on cancellation (process kill) the version must not stay
+            # latched in _pending, or a retransmit after reboot would be
+            # dropped as a duplicate without ever being made durable; any
+            # duplicate parked on ``durable`` must not hang either
+            self._pending.pop(req.version, None)
+            if not durable.is_ready():
+                from ..runtime.loop import Cancelled
+
+                durable._set_error(Cancelled())
         self._gate.advance_to(req.version)
         if req.version > self.version.get():
             self.version.set(req.version)

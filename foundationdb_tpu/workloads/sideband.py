@@ -14,10 +14,16 @@ from . import Workload
 
 
 class SidebandWorkload(Workload):
-    def __init__(self, db, rng, messages=25, prefix=b"sideband/", **kw):
+    def __init__(
+        self, db, rng, messages=25, prefix=b"sideband/", checker_db=None, **kw
+    ):
         super().__init__(db, rng, **kw)
         self.messages = messages
         self.prefix = prefix
+        # the checker reads through its own client (and so its own proxy
+        # choices) — causality must hold *across* clients, not just within
+        # one client's GRV stream
+        self.checker_db = checker_db or db
         self.stream: PromiseStream = PromiseStream()
         self.checked = 0
 
@@ -35,7 +41,7 @@ class SidebandWorkload(Workload):
                 i, version = await self.stream.next()
             except StreamClosed:
                 return
-            tr = self.db.transaction()
+            tr = self.checker_db.transaction()
             got = await tr.get(self.prefix + b"%04d" % i)
             assert got == b"sent", (
                 f"causality violation: message {i} committed at {version} "

@@ -334,3 +334,35 @@ def test_atomic_adds_apply_exactly_once():
         return True
 
     assert drive(sim, go())
+
+
+def test_atomic_then_snapshot_read_still_conflicts():
+    """Collapsing an atomic chain via a snapshot read must not strip the
+    read conflict from a later non-snapshot read of the same key (the
+    database-dependent determined value, ReadYourWrites semantics)."""
+    import struct
+
+    sim, cluster, db = make_db(seed=11)
+
+    async def go():
+        init = db.transaction()
+        init.set(b"ctr", struct.pack("<q", 5))
+        await init.commit()
+
+        tr = db.transaction()
+        await tr.get_read_version()
+        tr.atomic_op(MT.ADD, b"ctr", struct.pack("<q", 1))
+        v_snap = await tr.get(b"ctr", snapshot=True)  # collapses the chain
+        assert struct.unpack("<q", v_snap)[0] == 6
+        v = await tr.get(b"ctr")  # non-snapshot: must add read conflict
+        assert struct.unpack("<q", v)[0] == 6
+
+        other = db.transaction()
+        other.set(b"ctr", struct.pack("<q", 100))
+        await other.commit()
+
+        with pytest.raises(NotCommitted):
+            await tr.commit()
+        return True
+
+    assert drive(sim, go())

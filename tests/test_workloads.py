@@ -67,10 +67,9 @@ def test_conflict_range_oracle(seed):
 def test_sideband_causality():
     sim, cluster, db = make_db(seed=4, n_proxies=3)
     db2 = Database(sim, cluster.proxy_addrs, client_addr="client2")
-    w = SidebandWorkload(db, sim.loop.random.fork(), messages=20)
     # checker reads through a different client+proxy mix than the mutator
-    w.db = db
-    run_spec(sim, [w, RandomCloggingWorkload(db2, sim.loop.random.fork(), duration=2.0)])
+    w = SidebandWorkload(db, sim.loop.random.fork(), messages=20, checker_db=db2)
+    run_spec(sim, [w, RandomCloggingWorkload(db, sim.loop.random.fork(), duration=2.0)])
 
 
 def test_combined_spec_determinism():
