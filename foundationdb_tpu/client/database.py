@@ -4,6 +4,14 @@ The analog of fdbclient/NativeAPI's Cluster/Database (and the run-loop idiom
 every binding exposes, e.g. bindings/python/fdb/impl.py @transactional):
 holds the key-location cache (getKeyLocation:1059) and proxy endpoints, and
 ``run()`` retries a transaction body on retryable errors.
+
+Two connection modes:
+- static: an explicit proxy address list (the unit-test fast path);
+- dynamic (``Database.from_coordinators``): monitor the coordinators for
+  the elected cluster controller (fdbclient/MonitorLeader), then long-poll
+  its openDatabase endpoint for the current ClientDBInfo — the proxy list
+  refreshes itself across recoveries, exactly how a real client rides out
+  a master failure.
 """
 
 from __future__ import annotations
@@ -11,22 +19,93 @@ from __future__ import annotations
 from typing import Optional
 
 from ..net.sim import BrokenPromise, Endpoint, Sim
-from ..runtime.futures import delay
+from ..runtime.futures import AsyncVar, delay, settled, timeout, wait_for_any
 from ..runtime.knobs import Knobs
 from ..kv.keyrange_map import KeyRangeMap
-from ..server.interfaces import GetKeyServersRequest, Tokens
+from ..server.interfaces import (
+    GetKeyServersRequest,
+    OpenDatabaseRequest,
+    ProxyInterface,
+    Tokens,
+)
 from .transaction import Transaction
+
+_METHOD_FOR_TOKEN = {
+    Tokens.GRV: "grv",
+    Tokens.COMMIT: "commit",
+    Tokens.GET_KEY_SERVERS: "keyServers",
+}
 
 
 class Database:
-    def __init__(self, sim: Sim, proxy_addrs: list[str], client_addr: str = "client"):
+    def __init__(
+        self,
+        sim: Sim,
+        proxy_addrs: list[str] = None,
+        client_addr: str = "client",
+        coordinators: list[str] = None,
+    ):
         self.sim = sim
         self.knobs: Knobs = sim.knobs
-        self.proxy_addrs = proxy_addrs
         self.client = sim.processes.get(client_addr) or sim.new_process(client_addr)
         self.rng = sim.loop.random.fork()
+        self._proxies: AsyncVar = AsyncVar(
+            [ProxyInterface(a) for a in proxy_addrs] if proxy_addrs else None
+        )
         # location cache: key range → team addresses (None = unknown)
         self._locations = KeyRangeMap(default=None)
+        if coordinators:
+            self.client.spawn(self._monitor_proxies(coordinators))
+
+    @classmethod
+    def from_coordinators(
+        cls, sim: Sim, coordinators: list[str], client_addr: str = "client"
+    ) -> "Database":
+        return cls(sim, client_addr=client_addr, coordinators=coordinators)
+
+    @property
+    def proxy_addrs(self) -> list[str]:
+        ps = self._proxies.get() or []
+        return [p.address for p in ps]
+
+    # -- cluster-controller discovery (dynamic mode) ---------------------------
+
+    async def _monitor_proxies(self, coordinators: list[str]):
+        from ..server.coordination import monitor_leader
+
+        leader = AsyncVar(None)
+        self.client.spawn(monitor_leader(self.client, coordinators, leader))
+        known = -1
+        while True:
+            cc = leader.get()
+            if cc is None:
+                await leader.on_change()
+                continue
+            fut = self.client.request(
+                Endpoint(cc.address, Tokens.CC_OPEN_DATABASE),
+                OpenDatabaseRequest(known_id=known),
+            )
+            # re-issue when the CC changes under the long-poll; settled()
+            # keeps a BrokenPromise from killing this monitor actor
+            which = await wait_for_any([settled(fut), leader.on_change()])
+            if which == 1:
+                fut.cancel()
+                continue
+            if fut.is_error():
+                await delay(0.5)
+                continue
+            info = fut.get()
+            if info is None:
+                continue
+            known = info.id
+            self._proxies.set(list(info.proxies))
+            # topology moved: cached locations may be stale
+            self._locations = KeyRangeMap(default=None)
+
+    async def _get_proxies(self) -> list:
+        while not self._proxies.get():
+            await self._proxies.on_change()
+        return self._proxies.get()
 
     # -- routing ---------------------------------------------------------------
 
@@ -34,17 +113,23 @@ class Database:
         """RPC to some proxy. Safe-to-retry requests (GRV, key location)
         fail over across proxies; non-idempotent ones (commit) surface
         BrokenPromise to the caller, which maps it to commit_unknown_result."""
+        method = _METHOD_FOR_TOKEN[token]
+        proxies = await self._get_proxies()
         if not retry:
-            addr = self.rng.random_choice(self.proxy_addrs)
-            return await self.client.request(Endpoint(addr, token), req)
+            p = self.rng.random_choice(proxies)
+            return await self.client.request(p.ep(method), req)
         last_err = None
-        for attempt in range(3 * max(1, len(self.proxy_addrs))):
-            addr = self.rng.random_choice(self.proxy_addrs)
+        for attempt in range(60):
+            proxies = await self._get_proxies()
+            p = self.rng.random_choice(proxies)
             try:
-                return await self.client.request(Endpoint(addr, token), req)
+                return await self.client.request(p.ep(method), req)
             except BrokenPromise as e:
                 last_err = e
-                await delay(0.05 * (attempt + 1))
+                # dead epoch? wait a moment for a fresh proxy list
+                await wait_for_any(
+                    [self._proxies.on_change(), delay(0.05 * min(attempt + 1, 10))]
+                )
         raise last_err
 
     async def _locate(self, key: bytes):

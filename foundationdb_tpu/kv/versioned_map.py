@@ -106,6 +106,26 @@ class VersionedMap:
     def __iter__(self) -> Iterator[bytes]:
         return iter(self._keys)
 
+    # -- rollback (storageserver.actor.cpp:2172) ------------------------------
+
+    def rollback_after(self, version: int) -> None:
+        """Discard all history above `version` — the storage server's
+        rollback when a recovery's epoch-end cuts off versions it had
+        applied from a tlog whose tail didn't survive (rollback:2172)."""
+        if version >= self.latest_version:
+            return
+        dead: list[bytes] = []
+        for key, h in self._hist.items():
+            i = _find_le(h, version)
+            del h[i + 1 :]
+            if not h:
+                dead.append(key)
+        for key in dead:
+            del self._hist[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+        self.latest_version = version
+
     # -- compaction -----------------------------------------------------------
 
     def forget_before(self, version: int) -> None:

@@ -278,6 +278,15 @@ def wait_for_any(futures: list[Future]) -> Future[int]:
     return out
 
 
+def settled(fut: Future) -> Future[None]:
+    """A future that resolves (never errors) once ``fut`` completes — for
+    racing an error-prone future inside wait_for_any without the error
+    killing the waiter (flow's ``ready()``). Inspect ``fut`` afterwards."""
+    out: Future[None] = Future()
+    fut.add_callback(lambda f: out._set(None) if not out.is_ready() else None)
+    return out
+
+
 class TimedOut(Exception):
     pass
 

@@ -1,20 +1,27 @@
 """Cluster assembly: build a full database in the simulator.
 
-The analog of fdbserver/SimulatedCluster.actor.cpp (setupSimulatedSystem:886)
-for the static-recruitment stage: given a shape (counts of each role), create
-one simulated process per role, wire the endpoints, and lay out shards/tags:
+Two forms:
 
+- ``Cluster`` — static wiring (the analog of a hand-built
+  setupSimulatedSystem, SimulatedCluster.actor.cpp:886): one process per
+  role, fixed epoch-0 log system, no recovery. Fast to build; used by the
+  commit-path and workload unit tests.
+
+- ``DynamicCluster`` — the real thing: coordinator processes + worker
+  processes only. Workers campaign for cluster controllership through the
+  coordinators' leader election, the winning CC recruits a master, and the
+  master's recovery state machine (master.master_core) recruits every other
+  role and seeds storage. Kill the master/proxies/tlogs and the cluster
+  re-forms itself — the full §3.3 recovery loop of SURVEY.md.
+
+Layout rules shared by both:
 - storage server i carries tag i (fdbclient/FDBTypes.h:39 Tag)
 - storage servers group into teams of `replication` size; the key space is
   split evenly (by first byte) across teams — the static form of the
   shard map kept in \xff/keyServers/ (fdbclient/SystemData.cpp:33)
-- tag t lives on tlog (t mod n_tlogs); proxies push each version to every
-  tlog (TagPartitionedLogSystem push, filtered per tlog's tags)
+- each tag lives on `tlog_replication` tlogs of the current generation
 - the conflict-resolution key space splits evenly across resolvers
   (the keyResolvers map, MasterProxyServer.actor.cpp:233)
-
-Dynamic recruitment/recovery (ClusterController + master state machine)
-replaces this in the distribution stage (SURVEY.md §7 stage 6).
 """
 
 from __future__ import annotations
@@ -22,14 +29,18 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..kv.keyrange_map import KeyRangeMap
-from ..net.sim import Endpoint, Sim
+from ..net.sim import Sim
+from ..runtime.futures import AsyncVar
 from ..runtime.knobs import Knobs
-from .interfaces import Tokens
-from .master import Master
+from .coordination import CoordinatorServer
+from .interfaces import MasterInterface, ResolverInterface
+from .log_system import LogSystem, LogSystemConfig, TLogSet, assign_tags
+from .master import Master, _split_points
 from .proxy import Proxy, ShardMap
 from .resolver import Resolver
 from .storage import StorageServer
 from .tlog import TLog
+from .worker import Worker
 
 
 @dataclass
@@ -39,15 +50,24 @@ class ClusterConfig:
     n_tlogs: int = 1
     n_storage: int = 1
     replication: int = 1  # storage replicas per shard (team size)
+    tlog_replication: int = 1  # tlog replicas per tag
     conflict_backend: str = "oracle"
 
-
-def _split_points(n: int) -> list[bytes]:
-    """n-way even split of the key space by first byte."""
-    return [bytes([(256 * i) // n]) for i in range(1, n)]
+    def as_dict(self) -> dict:
+        return dict(
+            n_proxies=self.n_proxies,
+            n_resolvers=self.n_resolvers,
+            n_tlogs=self.n_tlogs,
+            n_storage=self.n_storage,
+            replication=self.replication,
+            tlog_replication=self.tlog_replication,
+            conflict_backend=self.conflict_backend,
+        )
 
 
 class Cluster:
+    """Statically wired single-epoch cluster (no recovery machinery)."""
+
     def __init__(self, sim: Sim, config: ClusterConfig = None, knobs: Knobs = None):
         self.sim = sim
         self.config = cfg = config or ClusterConfig()
@@ -59,18 +79,19 @@ class Cluster:
         p = sim.new_process("master")
         self.master.register(p)
 
-        # tlogs: tag t → tlog (t mod n_tlogs)
+        # tlogs (epoch 0)
         self.tlogs: list[TLog] = []
-        tlog_eps, tlog_tags = [], {}
-        all_tags = list(range(cfg.n_storage))
-        for i in range(cfg.n_tlogs):
-            owned = frozenset(t for t in all_tags if t % cfg.n_tlogs == i)
-            tl = TLog(self.knobs, tags=owned)
-            addr = f"tlog{i}"
-            tl.register(sim.new_process(addr))
+        addrs = [f"tlog{i}" for i in range(cfg.n_tlogs)]
+        log_ids = [f"tlog{i}" for i in range(cfg.n_tlogs)]
+        logs = assign_tags(addrs, log_ids, cfg.n_storage, cfg.tlog_replication)
+        for log in logs:
+            tl = TLog(self.knobs, tags=frozenset(log.tags), epoch=0, log_id=log.log_id)
+            tl.register_instance(sim.new_process(log.address))
             self.tlogs.append(tl)
-            tlog_eps.append(Endpoint(addr, Tokens.TLOG_COMMIT))
-            tlog_tags[addr] = owned
+        tlog_set = TLogSet(epoch=0, logs=tuple(logs), replication=cfg.tlog_replication)
+        self.log_config = AsyncVar(
+            LogSystemConfig(epoch=0, current=tlog_set, old=())
+        )
 
         # storage: teams of `replication` servers; even key split across teams
         self.storages: list[StorageServer] = []
@@ -82,10 +103,7 @@ class Cluster:
             addrs = [f"ss{t}" for t in members]
             shards.set_shard(bounds[team], bounds[team + 1], addrs, list(members))
         for t in range(cfg.n_storage):
-            tlog_addr = f"tlog{t % cfg.n_tlogs}"
-            ss = StorageServer(
-                tag=t, tlog_ep=Endpoint(tlog_addr, Tokens.TLOG_PEEK), knobs=self.knobs
-            )
+            ss = StorageServer(tag=t, log_config=self.log_config, knobs=self.knobs)
             ss.register(sim.new_process(f"ss{t}"))
             self.storages.append(ss)
         self.shards = shards
@@ -99,19 +117,16 @@ class Cluster:
             addr = f"resolver{i}"
             r.register(sim.new_process(addr))
             self.resolvers.append(r)
-            resolver_map.insert(
-                rbounds[i], rbounds[i + 1], Endpoint(addr, Tokens.RESOLVE)
-            )
+            resolver_map.insert(rbounds[i], rbounds[i + 1], ResolverInterface(addr))
 
         # proxies
         self.proxies: list[Proxy] = []
         self.proxy_addrs: list[str] = []
         for i in range(cfg.n_proxies):
             pr = Proxy(
-                master_addr="master",
+                master=MasterInterface("master"),
                 resolver_map=resolver_map,
-                tlog_eps=tlog_eps,
-                tlog_tags=tlog_tags,
+                log_system=LogSystem(tlog_set),
                 shards=shards,
                 knobs=self.knobs,
             )
@@ -128,3 +143,73 @@ class Cluster:
     def quiesce_version(self) -> int:
         """Highest committed version (for draining in tests — QuietDatabase)."""
         return self.master.live_committed
+
+
+class DynamicCluster:
+    """Coordinators + workers; everything else recruits itself (§3.3)."""
+
+    def __init__(
+        self,
+        sim: Sim,
+        config: ClusterConfig = None,
+        n_coordinators: int = 1,
+        n_workers: int = None,
+        knobs: Knobs = None,
+    ):
+        self.sim = sim
+        self.config = cfg = config or ClusterConfig()
+        self.knobs = knobs or sim.knobs
+        self.coordinators = [f"coord{i}" for i in range(n_coordinators)]
+        for addr in self.coordinators:
+            sim.new_process(addr, boot=_boot_coordinator)
+
+        # worker fleet: storage-class + transaction-class + stateless
+        if n_workers is None:
+            n_workers = (
+                cfg.n_storage
+                + cfg.n_tlogs
+                + cfg.n_proxies
+                + cfg.n_resolvers
+                + 2  # master + CC headroom
+            )
+        n_stateless = max(
+            2, n_workers - cfg.n_storage - cfg.n_tlogs
+        )
+        classes = (
+            ["storage"] * cfg.n_storage
+            + ["transaction"] * cfg.n_tlogs
+            + ["stateless"] * n_stateless
+        )
+        self.worker_addrs = []
+        for i, pclass in enumerate(classes):
+            addr = f"worker{i}"
+            self.worker_addrs.append(addr)
+            sim.new_process(
+                addr,
+                boot=_make_worker_boot(
+                    self.coordinators, pclass, cfg.as_dict(), self.knobs
+                ),
+            )
+
+
+def _boot_coordinator(process):
+    async def run():
+        CoordinatorServer().register(process)
+
+    return run()
+
+
+def _make_worker_boot(coordinators, pclass, config, knobs):
+    def boot(process):
+        async def run():
+            Worker(
+                process,
+                coordinators,
+                process_class=pclass,
+                initial_config=config,
+                knobs=knobs,
+            ).start()
+
+        return run()
+
+    return boot

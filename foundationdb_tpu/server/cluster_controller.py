@@ -1,0 +1,188 @@
+"""ClusterController: the elected singleton that owns cluster membership.
+
+The analog of fdbserver/ClusterController.actor.cpp: worker registry with
+lease-based failure detection (registrations double as heartbeats —
+registrationClient re-registers every HEARTBEAT_INTERVAL and an entry
+expires after FAILURE_TIMEOUT), master recruitment + respawn
+(clusterWatchDatabase:985), ServerDBInfo broadcast to every worker, and the
+client-facing openDatabase long-poll that serves the proxy list.
+
+The CC runs *inside* a worker that won the coordinators' leader election
+(coordination.try_become_leader); losing the leadership shuts it down.
+"""
+
+from __future__ import annotations
+
+from ..net.sim import Endpoint
+from ..runtime.futures import AsyncVar, delay, timeout
+from ..runtime.knobs import Knobs
+from ..runtime.loop import now
+from ..runtime.trace import SevInfo, SevWarn, trace
+from .interfaces import (
+    ClientDBInfo,
+    GetWorkersReply,
+    GetWorkersRequest,
+    OpenDatabaseRequest,
+    RecruitRoleRequest,
+    RegisterWorkerRequest,
+    ServerDBInfo,
+    SetDBInfoRequest,
+    Tokens,
+    WorkerDetails,
+)
+
+
+class ClusterController:
+    def __init__(self, process, coordinators, initial_config=None, knobs=None):
+        self.process = process
+        self.coordinators = coordinators
+        self.initial_config = initial_config or {}
+        self.knobs = knobs or Knobs()
+        self.workers: dict[str, tuple[WorkerDetails, float]] = {}  # addr → (d, seen)
+        self.db_info = AsyncVar(None)  # AsyncVar[ServerDBInfo]
+        self._actors = []
+        self._master_n = 0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        p = self.process
+        p.register(Tokens.CC_REGISTER_WORKER, self.register_worker)
+        p.register(Tokens.CC_GET_WORKERS, self.get_workers)
+        p.register(Tokens.CC_OPEN_DATABASE, self.open_database)
+        p.register(Tokens.CC_SET_DB_INFO, self.set_db_info)
+        p.register(Tokens.CC_GET_DB_INFO, self.get_db_info)
+        self._actors.append(p.spawn(self.cluster_watch_database()))
+        self._actors.append(p.spawn(self._broadcast_loop()))
+
+    def shutdown(self) -> None:
+        for t in (
+            Tokens.CC_REGISTER_WORKER,
+            Tokens.CC_GET_WORKERS,
+            Tokens.CC_OPEN_DATABASE,
+            Tokens.CC_SET_DB_INFO,
+            Tokens.CC_GET_DB_INFO,
+        ):
+            self.process.endpoints.pop(t, None)
+        for a in self._actors:
+            a.cancel()
+        self._actors.clear()
+
+    # -- worker registry --------------------------------------------------------
+
+    async def register_worker(self, req: RegisterWorkerRequest):
+        self.workers[req.address] = (
+            WorkerDetails(
+                address=req.address, process_class=req.process_class, roles=req.roles
+            ),
+            now(),
+        )
+        return None
+
+    def _alive_workers(self) -> list[WorkerDetails]:
+        cutoff = now() - self.knobs.FAILURE_TIMEOUT
+        return [d for d, seen in self.workers.values() if seen >= cutoff]
+
+    async def get_workers(self, _req: GetWorkersRequest) -> GetWorkersReply:
+        return GetWorkersReply(workers=self._alive_workers())
+
+    # -- master recruitment + respawn (clusterWatchDatabase:985) ----------------
+
+    async def cluster_watch_database(self):
+        while True:
+            workers = self._alive_workers()
+            if not workers:
+                await delay(self.knobs.HEARTBEAT_INTERVAL)
+                continue
+            # prefer a stateless-class worker not already running roles
+            workers.sort(key=lambda w: (w.process_class != "stateless", len(w.roles)))
+            target = workers[0]
+            self._master_n += 1
+            uid = f"master-{self._master_n}-{self.process.sim.loop.random.random_int(0, 1 << 20)}"
+            try:
+                await timeout(
+                    self.process.request(
+                        Endpoint(target.address, Tokens.WORKER_RECRUIT),
+                        RecruitRoleRequest(
+                            role="master",
+                            uid=uid,
+                            params=dict(
+                                coordinators=self.coordinators,
+                                cc_address=self.process.address,
+                                initial_config=self.initial_config,
+                            ),
+                        ),
+                    ),
+                    2.0,
+                )
+            except Exception:
+                await delay(self.knobs.HEARTBEAT_INTERVAL)
+                continue
+            trace(
+                SevInfo,
+                "RecruitedMaster",
+                self.process.address,
+                Worker=target.address,
+                Uid=uid,
+            )
+            # watch it: the master's ping endpoint vanishes when it dies
+            ping = Endpoint(target.address, f"master.ping#{uid}")
+            misses = 0
+            while misses < 3:
+                await delay(self.knobs.HEARTBEAT_INTERVAL)
+                try:
+                    r = await timeout(
+                        self.process.request(ping, None),
+                        self.knobs.HEARTBEAT_INTERVAL * 3,
+                    )
+                    misses = 0 if r is not None else misses + 1
+                except Exception:
+                    misses += 1
+            trace(SevWarn, "MasterFailed", self.process.address, Uid=uid)
+
+    # -- ServerDBInfo plumbing ---------------------------------------------------
+
+    async def set_db_info(self, req: SetDBInfoRequest):
+        cur = self.db_info.get()
+        if cur is None or req.info.id > cur.id:
+            self.db_info.set(req.info)
+        return None
+
+    async def get_db_info(self, _req) -> ServerDBInfo:
+        return self.db_info.get()
+
+    async def _broadcast_loop(self):
+        """Push ServerDBInfo to every live worker on change (and
+        periodically, for workers that registered after the last change)."""
+        sent: dict[str, int] = {}
+        while True:
+            info = self.db_info.get()
+            if info is not None:
+                for d in self._alive_workers():
+                    if sent.get(d.address) == info.id:
+                        continue
+                    try:
+                        await timeout(
+                            self.process.request(
+                                Endpoint(d.address, Tokens.WORKER_SET_DB_INFO),
+                                SetDBInfoRequest(info=info),
+                            ),
+                            1.0,
+                        )
+                        sent[d.address] = info.id
+                    except Exception:
+                        pass
+            change = self.db_info.on_change()
+            await_any = [change, delay(self.knobs.HEARTBEAT_INTERVAL)]
+            from ..runtime.futures import wait_for_any
+
+            await wait_for_any(await_any)
+
+    # -- client openDatabase -----------------------------------------------------
+
+    async def open_database(self, req: OpenDatabaseRequest) -> ClientDBInfo:
+        while True:
+            info = self.db_info.get()
+            if info is not None and info.client_info.id != req.known_id:
+                return info.client_info
+            await self.db_info.on_change()

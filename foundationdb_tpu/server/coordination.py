@@ -183,6 +183,23 @@ class CoordinatorServer:
         st.candidates = {
             a: (info, dl) for a, (info, dl) in st.candidates.items() if dl > t
         }
+        # a live nominee is sticky: it only loses the nomination to a
+        # *strictly better priority* candidate or by lease expiry — without
+        # this, every new candidate with a luckier change_id would steal
+        # the nomination and the cluster would elect controllers in a loop
+        # (the reference's leaderRegister keeps currentNominee the same way)
+        cur = st.nominee
+        if cur is not None:
+            live = st.candidates.get(cur.address)
+            if (
+                live is not None
+                and live[0].change_id == cur.change_id
+                and all(
+                    info.priority <= cur.priority
+                    for info, _dl in st.candidates.values()
+                )
+            ):
+                return
         best = None
         for info, _dl in st.candidates.values():
             if best is None or info.order() > best.order():

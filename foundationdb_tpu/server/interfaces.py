@@ -119,6 +119,21 @@ class TLogCommitRequest:
     version: Version = INVALID_VERSION
     # tag → mutations at this version (LogPushData's tagged messages)
     messages: dict[Tag, list[Mutation]] = field(default_factory=dict)
+    epoch: int = 0
+    known_committed: Version = 0  # piggybacked committed version
+
+
+@dataclass
+class TLogLockRequest:
+    """Recovery fence from a higher-epoch master (tLogLock:467)."""
+
+    epoch: int = 0
+
+
+@dataclass
+class TLogLockReply:
+    end_version: Version = INVALID_VERSION  # this tlog's durable version
+    known_committed: Version = 0
 
 
 @dataclass
@@ -169,6 +184,158 @@ class GetKeyValuesReply:
     more: bool = False
 
 
+# -- role interfaces (the *Interface.h structs): address + instance uid -------
+#
+# A role instance registers its handlers under "{token}#{uid}" so many
+# instances (e.g. tlog generations across epochs) can share one worker
+# process; uid == "" means the well-known static tokens (fdbrpc.h:56).
+
+
+def _suffixed(token: str, uid: str):
+    return token if not uid else f"{token}#{uid}"
+
+
+@dataclass(frozen=True)
+class MasterInterface:
+    address: str = ""
+    uid: str = ""
+
+    def ep(self, method: str):
+        from ..net.sim import Endpoint
+
+        token = {
+            "getCommitVersion": Tokens.GET_COMMIT_VERSION,
+            "reportCommitted": Tokens.REPORT_COMMITTED,
+            "getLiveCommitted": Tokens.GET_LIVE_COMMITTED,
+            "ping": "master.ping",
+        }[method]
+        return Endpoint(self.address, _suffixed(token, self.uid))
+
+
+@dataclass(frozen=True)
+class ProxyInterface:
+    address: str = ""
+    uid: str = ""
+
+    def ep(self, method: str):
+        from ..net.sim import Endpoint
+
+        token = {
+            "grv": Tokens.GRV,
+            "commit": Tokens.COMMIT,
+            "keyServers": Tokens.GET_KEY_SERVERS,
+            "ping": "proxy.ping",
+        }[method]
+        return Endpoint(self.address, _suffixed(token, self.uid))
+
+
+@dataclass(frozen=True)
+class ResolverInterface:
+    address: str = ""
+    uid: str = ""
+
+    def ep(self, method: str):
+        from ..net.sim import Endpoint
+
+        token = {"resolve": Tokens.RESOLVE, "ping": "resolver.ping"}[method]
+        return Endpoint(self.address, _suffixed(token, self.uid))
+
+
+@dataclass(frozen=True)
+class StorageInterface:
+    """Storage keeps well-known data tokens (one storage role per process;
+    it outlives recoveries) plus a uid-suffixed ping."""
+
+    address: str = ""
+    uid: str = ""
+    tag: Tag = 0
+
+    def ep(self, method: str):
+        from ..net.sim import Endpoint
+
+        token = {
+            "getValue": Tokens.GET_VALUE,
+            "getKeyValues": Tokens.GET_KEY_VALUES,
+        }.get(method)
+        if token is not None:
+            return Endpoint(self.address, token)
+        return Endpoint(self.address, _suffixed(f"storage.{method}", self.uid))
+
+
+# -- worker / cluster controller (WorkerInterface.h, ClusterInterface.h) ------
+
+
+@dataclass
+class RegisterWorkerRequest:
+    address: str = ""
+    process_class: str = "unset"  # storage | transaction | stateless | unset
+    roles: tuple = ()  # role kinds currently hosted (for fitness)
+
+
+@dataclass
+class GetWorkersRequest:
+    pass
+
+
+@dataclass
+class WorkerDetails:
+    address: str = ""
+    process_class: str = "unset"
+    roles: tuple = ()
+
+
+@dataclass
+class GetWorkersReply:
+    workers: list = field(default_factory=list)  # [WorkerDetails]
+
+
+@dataclass
+class RecruitRoleRequest:
+    """CC/master → worker: instantiate a role (worker.actor.cpp:693-794)."""
+
+    role: str = ""  # master | proxy | resolver | tlog | storage
+    uid: str = ""
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class RecruitRoleReply:
+    address: str = ""
+    uid: str = ""
+
+
+@dataclass
+class OpenDatabaseRequest:
+    """Client → CC: long-polled ClientDBInfo (serves the proxy list)."""
+
+    known_id: int = -1
+
+
+@dataclass
+class ClientDBInfo:
+    id: int = 0
+    proxies: list = field(default_factory=list)  # proxy addresses
+
+
+@dataclass
+class ServerDBInfo:
+    """Broadcast cluster topology (the reference's ServerDBInfo pushed by
+    the CC to every worker). None fields = not yet recovered."""
+
+    id: int = 0
+    recovery_count: int = 0
+    master_address: str = ""
+    master_uid: str = ""
+    client_info: ClientDBInfo = None
+    log_system: object = None  # log_system.LogSystemConfig
+    recovery_version: Version = 0  # epoch-end of the previous generation
+
+
+@dataclass
+class SetDBInfoRequest:
+    info: ServerDBInfo = None
+
+
 # -- endpoint token names (well-known, fdbrpc/fdbrpc.h:56) --------------------
 
 
@@ -183,11 +350,19 @@ class Tokens:
     GET_KEY_SERVERS = "proxy.getKeyServers"
     # resolver
     RESOLVE = "resolver.resolve"
-    # tlog
-    TLOG_COMMIT = "tlog.commit"
-    TLOG_PEEK = "tlog.peek"
-    TLOG_POP = "tlog.pop"
+    # tlog endpoints are always id-suffixed (TLogInterface.ep — many
+    # generations share a worker), so they have no well-known tokens here
     # storage
     GET_VALUE = "storage.getValue"
     GET_KEY_VALUES = "storage.getKeyValues"
     GET_SHARD_STATE = "storage.getShardState"
+    # worker
+    WORKER_RECRUIT = "worker.recruit"
+    WORKER_SET_DB_INFO = "worker.setDBInfo"
+    WORKER_PING = "worker.ping"
+    # cluster controller
+    CC_REGISTER_WORKER = "cc.registerWorker"
+    CC_GET_WORKERS = "cc.getWorkers"
+    CC_OPEN_DATABASE = "cc.openDatabase"
+    CC_SET_DB_INFO = "cc.setDBInfo"
+    CC_GET_DB_INFO = "cc.getServerDBInfo"

@@ -1,36 +1,75 @@
-"""Master role: commit-version assignment and committed-version tracking.
+"""Master role: version assignment, committed-version tracking, and the
+recovery state machine.
 
-The analog of the reference's version-assignment half of the master
-(fdbserver/masterserver.actor.cpp: getVersion:763 / provideVersions:830 and
-the liveCommittedVersion bookkeeping). The recovery state machine joins in
-the distribution stage (SURVEY.md §7 stage 6); here the master is the
-cluster's single version authority:
+The analog of fdbserver/masterserver.actor.cpp. Two halves:
 
-- ``getCommitVersion`` hands out a strictly increasing (prev_version,
-  version) pair per commit batch; the prev→version chain is what lets
-  resolvers and tlogs apply batches in version order with no other
-  coordination (Resolver.actor.cpp:104-122).
-- Commit versions advance with wall (virtual) time at VERSIONS_PER_SECOND so
-  versions double as coarse timestamps, like the reference.
+- ``Master`` — the version authority (getVersion:763 / provideVersions:830
+  and liveCommittedVersion bookkeeping): hands out strictly increasing
+  (prev_version, version) pairs per commit batch; the prev→version chain is
+  what lets resolvers and tlogs apply batches in version order with no
+  other coordination (Resolver.actor.cpp:104-122). Versions advance with
+  wall (virtual) time at VERSIONS_PER_SECOND so they double as coarse
+  timestamps, like the reference.
+
+- ``master_core`` — the recovery state machine (masterCore:1077-1240):
+    READING_CSTATE    read prior DBCoreState via coordinator majority
+    LOCKING_CSTATE    lock the prior tlog generation; its epoch-end
+                      version (min durable over locked replicas) becomes
+                      the recovery version
+    RECRUITING        new tlogs/resolvers/proxies on workers from the CC
+                      (+ seed storage servers on a brand-new database)
+    RECOVERY_TXN      initialize the new systems at the recovery version
+    WRITING_CSTATE    fence: write the new generation into the coordinated
+                      state (a newer recovery attempt wins here)
+    FULLY_RECOVERED   publish ServerDBInfo through the CC; then keep
+                      watching role failures (any death ⇒ master dies ⇒
+                      the CC recruits a successor ⇒ recovery again) and
+                      dropping old tlog generations once every storage
+                      server has caught up (trackTlogRecovery:1009).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+
+from ..kv.keyrange_map import KeyRangeMap
+from ..runtime.futures import delay, wait_for_all
 from ..runtime.loop import now
+from ..runtime.trace import SevInfo, SevWarn, trace
+from .coordination import ClusterStateChanged, CoordinatedState
 from .interfaces import (
+    ClientDBInfo,
     GetCommitVersionReply,
     GetCommitVersionRequest,
     GetReadVersionReply,
+    GetWorkersRequest,
+    MasterInterface,
+    ProxyInterface,
+    RecruitRoleRequest,
     ReportRawCommittedVersionRequest,
+    ResolverInterface,
+    ServerDBInfo,
+    SetDBInfoRequest,
+    StorageInterface,
     Tokens,
 )
+from .log_system import (
+    LogSystemConfig,
+    OldTLogSet,
+    TLogSet,
+    assign_tags,
+    epoch_end_version,
+    lock_tlog_set,
+)
+from ..net.sim import Endpoint
 
 VERSIONS_PER_SECOND = 1_000_000
 MAX_VERSION_JUMP = 10 * VERSIONS_PER_SECOND
 
 
 class Master:
-    def __init__(self, first_version: int = 0):
+    def __init__(self, first_version: int = 0, uid: str = ""):
+        self.uid = uid
         self.last_assigned = first_version
         self.last_assigned_at = 0.0
         self.live_committed = first_version
@@ -56,9 +95,421 @@ class Master:
     async def get_live_committed(self, _req) -> GetReadVersionReply:
         return GetReadVersionReply(version=self.live_committed)
 
+    async def _ping(self, _req):
+        return "pong"
+
     # -- wiring ----------------------------------------------------------------
 
     def register(self, process) -> None:
         process.register(Tokens.GET_COMMIT_VERSION, self.get_commit_version)
         process.register(Tokens.REPORT_COMMITTED, self.report_committed)
         process.register(Tokens.GET_LIVE_COMMITTED, self.get_live_committed)
+
+    def register_instance(self, process) -> None:
+        process.register(
+            f"{Tokens.GET_COMMIT_VERSION}#{self.uid}", self.get_commit_version
+        )
+        process.register(f"{Tokens.REPORT_COMMITTED}#{self.uid}", self.report_committed)
+        process.register(
+            f"{Tokens.GET_LIVE_COMMITTED}#{self.uid}", self.get_live_committed
+        )
+        process.register(f"master.ping#{self.uid}", self._ping)
+
+
+# -- the coordinated core state (DBCoreState, fdbserver/DBCoreState.h) ---------
+
+
+@dataclass
+class DBCoreState:
+    recovery_count: int = 0
+    tlog_set: TLogSet = None  # current generation
+    old_tlog_sets: tuple = ()  # tuple[OldTLogSet]
+    recovery_version: int = 0  # current generation starts above this
+    storage: tuple = ()  # tuple[StorageInterface]
+    shards: tuple = ()  # tuple[(begin, end, addrs, tags)]
+    config: dict = field(default_factory=dict)  # cluster shape knobs
+
+
+class MasterTerminated(Exception):
+    """This master's tenure is over (fenced, or a role it recruited died)."""
+
+
+async def master_core(process, uid: str, coordinators, cc_address, initial_config):
+    """The whole master lifetime: recovery, then service until failure.
+    Raises MasterTerminated/ClusterStateChanged when a successor must be
+    recruited; the worker deregisters our endpoints then."""
+    from .proxy import Proxy, ShardMap
+    from .log_system import LogSystem
+
+    # the CC failure-detects us from the moment of recruitment — the ping
+    # endpoint must exist before any slow recovery step, or a recovery
+    # taking longer than the CC's miss budget looks like a dead master
+    async def _pong(_req):
+        return "pong"
+
+    process.register(f"master.ping#{uid}", _pong)
+
+    # READING_CSTATE
+    cs = CoordinatedState(process, coordinators)
+    prev: DBCoreState = await cs.read()
+    recovery_count = (prev.recovery_count + 1) if prev else 1
+    config = dict(initial_config or {})
+    if prev:
+        config = dict(prev.config)
+    trace(
+        SevInfo,
+        "MasterRecoveryState",
+        process.address,
+        State="reading_cstate_done",
+        RecoveryCount=recovery_count,
+    )
+
+    # LOCKING_CSTATE: fence the prior generation, find the recovery version
+    old_sets: list[OldTLogSet] = []
+    recovery_version = 0
+    if prev:
+        locks = await lock_tlog_set(process, prev.tlog_set, recovery_count)
+        recovery_version = epoch_end_version(locks)
+        known = max(r.known_committed for r in locks.values())
+        assert recovery_version >= known, "epoch end below a committed version"
+        old_sets = [o for o in prev.old_tlog_sets]
+        old_sets.append(OldTLogSet(set=prev.tlog_set, end_version=recovery_version))
+        trace(
+            SevInfo,
+            "MasterRecoveryState",
+            process.address,
+            State="locked",
+            RecoveryVersion=recovery_version,
+        )
+
+    # RECRUITING — wait for the worker registry to stabilize (registration
+    # is lease-based; right after CC election it is still filling up)
+    workers, prev_count = [], -1
+    while True:
+        reply = await process.request(
+            Endpoint(cc_address, Tokens.CC_GET_WORKERS), GetWorkersRequest()
+        )
+        workers = [w for w in reply.workers if w.address != ""]
+        enough = prev and workers
+        if not prev:
+            enough = len(workers) >= int(config.get("n_storage", 1))
+        if enough and len(workers) == prev_count:
+            break
+        prev_count = len(workers)
+        await delay(0.6)
+    picker = _RolePicker(workers, avoid={process.address})
+
+    n_storage = int(config.get("n_storage", 1))
+    n_tlogs = int(config.get("n_tlogs", 1))
+    n_resolvers = int(config.get("n_resolvers", 1))
+    n_proxies = int(config.get("n_proxies", 1))
+    replication = int(config.get("replication", 1))
+    tlog_replication = int(config.get("tlog_replication", 1))
+    backend = config.get("conflict_backend", "oracle")
+
+    # storage: seeded once on a brand-new database, then immortal
+    if prev:
+        storage = list(prev.storage)
+        shards = list(prev.shards)
+    else:
+        storage, shards = await _seed_storage(
+            process, picker, n_storage, replication, uid
+        )
+
+    # new tlog generation (uids carry the master uid: a failed prior
+    # attempt at this recovery_count must not collide)
+    tlog_workers = picker.pick("tlog", n_tlogs)
+    log_ids = [f"log-{recovery_count}-{i}-{uid}" for i in range(n_tlogs)]
+    logs = assign_tags(
+        [w.address for w in tlog_workers], log_ids, n_storage, tlog_replication
+    )
+    await wait_for_all(
+        [
+            process.request(
+                Endpoint(log.address, Tokens.WORKER_RECRUIT),
+                RecruitRoleRequest(
+                    role="tlog",
+                    uid=log.log_id,
+                    params=dict(
+                        epoch=recovery_count,
+                        tags=frozenset(log.tags),
+                        first_version=recovery_version,
+                    ),
+                ),
+            )
+            for log in logs
+        ]
+    )
+    tlog_set = TLogSet(
+        epoch=recovery_count, logs=tuple(logs), replication=tlog_replication
+    )
+
+    # resolvers
+    resolver_workers = picker.pick("resolver", n_resolvers)
+    resolver_ifaces = []
+    for i, w in enumerate(resolver_workers):
+        r_uid = f"res-{recovery_count}-{i}-{uid}"
+        await process.request(
+            Endpoint(w.address, Tokens.WORKER_RECRUIT),
+            RecruitRoleRequest(
+                role="resolver",
+                uid=r_uid,
+                params=dict(
+                    backend=backend,
+                    first_version=recovery_version,
+                    epoch=recovery_count,
+                ),
+            ),
+        )
+        resolver_ifaces.append(ResolverInterface(address=w.address, uid=r_uid))
+
+    # RECOVERY_TXN: initialize version authority at the recovery version
+    master = Master(first_version=recovery_version, uid=uid)
+    master.register_instance(process)
+    master_iface = MasterInterface(address=process.address, uid=uid)
+
+    # proxies (they need everything above)
+    resolver_map = KeyRangeMap()
+    rbounds = [b""] + _split_points(n_resolvers) + [None]
+    for i, iface in enumerate(resolver_ifaces):
+        resolver_map.insert(rbounds[i], rbounds[i + 1], iface)
+    shard_map = ShardMap()
+    for begin, end, addrs, tags in shards:
+        shard_map.set_shard(begin, end, addrs, tags)
+
+    proxy_workers = picker.pick("proxy", n_proxies)
+    proxy_ifaces = []
+    for i, w in enumerate(proxy_workers):
+        p_uid = f"proxy-{recovery_count}-{i}-{uid}"
+        await process.request(
+            Endpoint(w.address, Tokens.WORKER_RECRUIT),
+            RecruitRoleRequest(
+                role="proxy",
+                uid=p_uid,
+                params=dict(
+                    master=master_iface,
+                    resolver_map=resolver_map,
+                    log_system=LogSystem(tlog_set),
+                    shards=shard_map,
+                    epoch=recovery_count,
+                    recovery_version=recovery_version,
+                ),
+            ),
+        )
+        proxy_ifaces.append(ProxyInterface(address=w.address, uid=p_uid))
+
+    # WRITING_CSTATE: fence. After this, the new generation is THE database.
+    core = DBCoreState(
+        recovery_count=recovery_count,
+        tlog_set=tlog_set,
+        old_tlog_sets=tuple(old_sets),
+        recovery_version=recovery_version,
+        storage=tuple(storage),
+        shards=tuple(shards),
+        config=config,
+    )
+    await cs.write(core)  # raises ClusterStateChanged if a successor fenced us
+
+    # FULLY_RECOVERED: publish
+    info = ServerDBInfo(
+        id=recovery_count * 1000,
+        recovery_count=recovery_count,
+        master_address=process.address,
+        master_uid=uid,
+        client_info=ClientDBInfo(
+            id=recovery_count * 1000, proxies=list(proxy_ifaces)
+        ),
+        log_system=LogSystemConfig(
+            epoch=recovery_count, current=tlog_set, old=tuple(old_sets)
+        ),
+        recovery_version=recovery_version,
+    )
+    await process.request(
+        Endpoint(cc_address, Tokens.CC_SET_DB_INFO), SetDBInfoRequest(info=info)
+    )
+    trace(
+        SevInfo,
+        "MasterFullyRecovered",
+        process.address,
+        RecoveryCount=recovery_count,
+        RecoveryVersion=recovery_version,
+    )
+
+    # service: watch for role failure; drop old tlog generations when safe
+    watched = (
+        [(i.ep("ping"), "proxy") for i in proxy_ifaces]
+        + [(i.ep("ping"), "resolver") for i in resolver_ifaces]
+        + [(log.ep("ping"), "tlog") for log in tlog_set.logs]
+    )
+    track = process.spawn(
+        _track_tlog_recovery(process, cs, core, info, cc_address, storage)
+    )
+    try:
+        await _wait_failure(process, watched)
+    finally:
+        track.cancel()
+    raise MasterTerminated("a recruited role failed")
+
+
+# -- recruitment helpers -------------------------------------------------------
+
+
+_CLASS_FOR_ROLE = {
+    "storage": "storage",
+    "tlog": "transaction",
+    "proxy": "stateless",
+    "resolver": "resolver",
+    "master": "stateless",
+}
+
+
+class _RolePicker:
+    """Fitness-based worker choice (getWorkerForRoleInDatacenter:388),
+    simplified: prefer matching process class, then least-loaded."""
+
+    def __init__(self, workers, avoid=frozenset()):
+        self.workers = workers
+        self.load = {w.address: len(w.roles) for w in workers}
+        self.avoid = avoid
+
+    def pick(self, role: str, n: int) -> list:
+        want = _CLASS_FOR_ROLE.get(role, "stateless")
+
+        def fitness(w):
+            return (
+                w.process_class != want,  # matching class first
+                w.address in self.avoid,
+                self.load[w.address],
+            )
+
+        chosen = []
+        for _ in range(n):
+            w = min(self.workers, key=fitness)
+            chosen.append(w)
+            self.load[w.address] += 1
+        return chosen
+
+
+def _split_points(n: int) -> list[bytes]:
+    return [bytes([(256 * i) // n]) for i in range(1, n)]
+
+
+async def _seed_storage(process, picker: _RolePicker, n_storage, replication, m_uid):
+    """First-recovery storage seeding (the reference's seedShardServers):
+    one storage role per chosen worker, teams of `replication`, even key
+    split across teams.
+
+    Deterministic choice + deterministic uids ("ss-<tag>") make seeding
+    idempotent across fenced master attempts: a re-seed lands on the same
+    workers and adopts the roles the failed attempt already created."""
+    assert n_storage % replication == 0, "storage must fill teams"
+    pool = sorted(
+        picker.workers,
+        key=lambda w: (w.process_class != "storage", w.address),
+    )
+    workers = pool[:n_storage]
+    assert len({w.address for w in workers}) == len(workers), (
+        "storage roles need distinct workers (one per process)"
+    )
+    storage = []
+    for tag, w in enumerate(workers):
+        s_uid = f"ss-{tag}"
+        await process.request(
+            Endpoint(w.address, Tokens.WORKER_RECRUIT),
+            RecruitRoleRequest(role="storage", uid=s_uid, params=dict(tag=tag)),
+        )
+        storage.append(StorageInterface(address=w.address, uid=s_uid, tag=tag))
+    n_teams = n_storage // replication
+    bounds = [b""] + _split_points(n_teams) + [None]
+    shards = []
+    for team in range(n_teams):
+        members = list(range(team * replication, (team + 1) * replication))
+        addrs = tuple(storage[t].address for t in members)
+        shards.append((bounds[team], bounds[team + 1], addrs, tuple(members)))
+    return storage, shards
+
+
+# -- ongoing service actors ----------------------------------------------------
+
+
+async def _wait_failure(process, watched, interval=0.3, misses_allowed=4):
+    """waitFailureClient over every recruited role; returns when one dies."""
+    misses = {ep.address + ep.token: 0 for ep, _ in watched}
+    while True:
+        await delay(interval)
+        for ep, kind in watched:
+            key = ep.address + ep.token
+            try:
+                from ..runtime.futures import timeout as _timeout
+                from ..net.sim import BrokenPromise
+
+                r = await _timeout(process.request(ep, None), interval * 2)
+                if r is None:
+                    raise BrokenPromise("ping timeout")
+                misses[key] = 0
+            except Exception:
+                misses[key] += 1
+                if misses[key] >= misses_allowed:
+                    trace(
+                        SevWarn,
+                        "MasterSawRoleFailure",
+                        process.address,
+                        Role=kind,
+                        Endpoint=str(ep),
+                    )
+                    return
+
+
+async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
+    """Once every storage server's version passed the recovery version, the
+    old tlog generations are no longer needed: rewrite the cstate without
+    them and republish (trackTlogRecovery, masterserver.actor.cpp:1009)."""
+    if not core.old_tlog_sets:
+        return
+    while True:
+        await delay(1.0)
+        try:
+            replies = await wait_for_all(
+                [
+                    process.request(s.ep("version"), None)
+                    for s in storage
+                ]
+            )
+        except Exception:
+            continue
+        # a server counts as caught up only once it follows THIS epoch:
+        # before that its version may contain a discarded pre-recovery
+        # tail it hasn't rolled back yet
+        if all(
+            epoch == core.recovery_count and version > core.recovery_version
+            for version, epoch in replies
+        ):
+            break
+    new_core = DBCoreState(
+        recovery_count=core.recovery_count,
+        tlog_set=core.tlog_set,
+        old_tlog_sets=(),
+        recovery_version=core.recovery_version,
+        storage=core.storage,
+        shards=core.shards,
+        config=core.config,
+    )
+    try:
+        await cs.write(new_core)
+    except ClusterStateChanged:
+        return  # a successor owns the state now; it will handle cleanup
+    new_info = ServerDBInfo(
+        id=info.id + 1,
+        recovery_count=info.recovery_count,
+        master_address=info.master_address,
+        master_uid=info.master_uid,
+        client_info=info.client_info,
+        log_system=LogSystemConfig(
+            epoch=core.recovery_count, current=core.tlog_set, old=()
+        ),
+        recovery_version=core.recovery_version,
+    )
+    await process.request(
+        Endpoint(cc_address, Tokens.CC_SET_DB_INFO), SetDBInfoRequest(info=new_info)
+    )
+    trace(SevInfo, "OldTLogGenerationsDropped", process.address)

@@ -11,16 +11,21 @@ The analog of fdbserver/MasterProxyServer.actor.cpp:
               (ResolutionRequestBuilder:233), resolve, combine verdicts
     3 (:414)  substitute versionstamps, tag committed mutations per
               storage team (tagsForKey, :540-580)
-    4 (:800)  push to every tlog, wait for the durability quorum
+    4 (:800)  push to the epoch's tlog set, wait for the durability quorum
     5 (:804)  advance committed version (master report, awaited — this is
               what makes GRV causally safe), reply per-txn
 - GRV service (transactionStarter:925 / getLiveCommittedVersion:875):
   batched; returns the master's live committed version.
-- key-location service (readRequestServer:1036) from the static shard map.
+- key-location service (readRequestServer:1036) from the shard map.
 
 Batches are pipelined: phase 1-2 of batch N+1 may run while batch N logs
 (the latestLocalCommitBatchResolving/Logging gates, :353,415); version
 chaining at resolver and tlog keeps application ordered.
+
+A proxy belongs to one epoch. When its tlog set is locked by a recovering
+master (TLogStopped from a push) the proxy is dead: it fails every pending
+and future request, exactly like a reference proxy cut off at recovery —
+clients see commit_unknown_result and move to the new epoch's proxies.
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ from ..conflict.api import Verdict
 from ..errors import NotCommitted, TransactionTooOld
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
-from ..net.sim import Endpoint
+from ..net.sim import BrokenPromise
 from ..runtime.futures import Future, delay, wait_for_all, wait_for_any
 from ..runtime.knobs import Knobs
 from .interfaces import (
@@ -42,19 +47,20 @@ from .interfaces import (
     GetKeyServersRequest,
     GetReadVersionReply,
     GetReadVersionRequest,
+    MasterInterface,
     ReportRawCommittedVersionRequest,
     ResolveBatchRequest,
-    TLogCommitRequest,
     Tokens,
     TransactionData,
     Version,
 )
+from .log_system import LogSystem
+from .tlog import TLogStopped
 
 
 class ShardMap:
-    """Static key → (team addresses, tags) map; the proxy's keyInfo
-    (ApplyMetadataMutation keeps this live in the reference; static until
-    the data-distribution stage)."""
+    """Key → (team addresses, tags) map; the proxy's keyInfo
+    (ApplyMetadataMutation keeps this live in the reference)."""
 
     def __init__(self):
         self.map = KeyRangeMap(default=None)  # → (tuple(addresses), tuple(tags))
@@ -77,26 +83,32 @@ class ShardMap:
         return begin, end, v[0]
 
 
+class ProxyDead(Exception):
+    """This proxy's epoch ended (its tlogs are locked)."""
+
+
 class Proxy:
     def __init__(
         self,
-        master_addr: str,
-        resolver_map: KeyRangeMap,  # key range → resolver endpoint
-        tlog_eps: list,
-        tlog_tags: dict,  # tlog address → frozenset of tags (None = all)
+        master: MasterInterface,
+        resolver_map: KeyRangeMap,  # key range → ResolverInterface
+        log_system: LogSystem,
         shards: ShardMap,
         knobs: Knobs = None,
+        epoch: int = 0,
+        recovery_version: Version = 0,
+        uid: str = "",
     ):
-        self.master_version_ep = Endpoint(master_addr, Tokens.GET_COMMIT_VERSION)
-        self.master_report_ep = Endpoint(master_addr, Tokens.REPORT_COMMITTED)
-        self.master_live_ep = Endpoint(master_addr, Tokens.GET_LIVE_COMMITTED)
+        self.master = master
         self.resolver_map = resolver_map
-        self.tlog_eps = tlog_eps
-        self.tlog_tags = tlog_tags
+        self.log_system = log_system
         self.shards = shards
         self.knobs = knobs or Knobs()
-        self.committed_version: Version = 0
-        self.last_resolver_versions: Version = 0
+        self.epoch = epoch
+        self.uid = uid
+        self.committed_version: Version = recovery_version
+        self.last_resolver_versions: Version = recovery_version
+        self.failed = False
         self.process = None
         self._batch: list[tuple[TransactionData, Future]] = []
         self._batch_trigger: Future = Future()
@@ -105,20 +117,23 @@ class Proxy:
     # -- GRV -------------------------------------------------------------------
 
     async def get_read_version(self, _req: GetReadVersionRequest) -> GetReadVersionReply:
+        self._check_alive()
         # the master's live committed version (reported there before commit
         # acks reach clients) makes reads causally consistent across proxies
-        live = await self.process.request(self.master_live_ep, None)
+        live = await self.process.request(self.master.ep("getLiveCommitted"), None)
         return GetReadVersionReply(version=live.version)
 
     # -- key location ----------------------------------------------------------
 
     async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
+        self._check_alive()
         begin, end, team = self.shards.team_for_key(req.key)
         return GetKeyServersReply(begin=begin, end=end, team=list(team))
 
     # -- commit ----------------------------------------------------------------
 
     async def commit(self, req: CommitRequest) -> CommitReply:
+        self._check_alive()
         done: Future = Future()
         self._batch.append((req.transaction, done))
         if len(self._batch) == 1:
@@ -146,6 +161,13 @@ class Proxy:
         replies = [f for _, f in batch]
         try:
             await self._commit_batch(batch)
+        except TLogStopped as e:
+            # this epoch is over: a recovering master locked our tlogs
+            self.failed = True
+            for f in replies:
+                if not f.is_ready():
+                    f._set_error(BrokenPromise(str(e)))
+            raise
         except BaseException as e:
             # a failed dependency (master/resolver/tlog unreachable) must
             # error every pending commit, not leave clients hanging; they
@@ -161,7 +183,8 @@ class Proxy:
 
         # phase 1: version assignment
         vreq = await self.process.request(
-            self.master_version_ep, GetCommitVersionRequest()
+            self.master.ep("getCommitVersion"),
+            GetCommitVersionRequest(requesting_proxy=self.uid),
         )
         prev_version, version = vreq.prev_version, vreq.version
 
@@ -184,33 +207,25 @@ class Proxy:
                 for tag in tags:
                     to_log.setdefault(tag, []).append(m)
 
-        # phase 4: push to tlogs. Application order is enforced by the
-        # tlogs' own prev_version chaining, so pushes of successive batches
-        # may be in flight simultaneously (the reference's pipelining).
-        pushes = []
-        for ep in self.tlog_eps:
-            owned = self.tlog_tags.get(ep.address)
-            msgs = (
-                to_log
-                if owned is None
-                else {t: ms for t, ms in to_log.items() if t in owned}
-            )
-            pushes.append(
-                self.process.request(
-                    ep,
-                    TLogCommitRequest(
-                        prev_version=prev_version, version=version, messages=msgs
-                    ),
-                )
-            )
-        await wait_for_all(pushes)
+        # phase 4: push to the tlog set. Application order is enforced by
+        # the tlogs' own prev_version chaining, so pushes of successive
+        # batches may be in flight simultaneously (the reference's
+        # pipelining).
+        await self.log_system.push(
+            self.process,
+            prev_version,
+            version,
+            to_log,
+            known_committed=self.committed_version,
+        )
 
         # phase 5: make the commit visible, then reply. The awaited master
         # report is what lets any proxy's GRV see this commit (causality).
         if version > self.committed_version:
             self.committed_version = version
         await self.process.request(
-            self.master_report_ep, ReportRawCommittedVersionRequest(version=version)
+            self.master.ep("reportCommitted"),
+            ReportRawCommittedVersionRequest(version=version),
         )
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
@@ -225,12 +240,12 @@ class Proxy:
         resolver sees the conflict-range pieces inside its key partition;
         verdicts combine conservatively (committed iff every involved
         resolver committed)."""
-        resolvers = {}  # ep.address → (ep, [txn indices], [TransactionData])
-        for r_begin, r_end, ep in self.resolver_map.ranges():
-            resolvers[ep.address] = (ep, r_begin, r_end, [], [])
+        resolvers = {}  # iface.uid/addr → (iface, begin, end, idxs, datas)
+        for r_begin, r_end, iface in self.resolver_map.ranges():
+            resolvers[(iface.address, iface.uid)] = (iface, r_begin, r_end, [], [])
 
         single = len(resolvers) == 1
-        for addr, (ep, r_begin, r_end, idxs, datas) in resolvers.items():
+        for _key, (iface, r_begin, r_end, idxs, datas) in resolvers.items():
             for i, t in enumerate(txns):
                 if single:
                     rcr, wcr = t.read_conflict_ranges, t.write_conflict_ranges
@@ -249,17 +264,17 @@ class Proxy:
 
         verdicts = [Verdict.COMMITTED] * len(txns)
         reqs, meta = [], []
-        for addr, (ep, _b, _e, idxs, datas) in resolvers.items():
+        for _key, (iface, _b, _e, idxs, datas) in resolvers.items():
             # every resolver sees every version to keep its chain advancing,
             # even with no transactions for it (Resolver.actor.cpp:104-122)
             reqs.append(
                 self.process.request(
-                    ep,
+                    iface.ep("resolve"),
                     ResolveBatchRequest(
                         prev_version=prev_version,
                         version=version,
                         last_receive_version=self.last_resolver_versions,
-                        requesting_proxy=self.process.address,
+                        requesting_proxy=f"{self.process.address}#{self.uid}",
                         transactions=datas,
                     ),
                 )
@@ -274,12 +289,29 @@ class Proxy:
 
     # -- wiring ----------------------------------------------------------------
 
+    def _check_alive(self):
+        if self.failed:
+            raise BrokenPromise(f"proxy {self.uid} epoch {self.epoch} is dead")
+
     def register(self, process) -> None:
+        """Well-known tokens (static cluster)."""
         self.process = process
         process.register(Tokens.GRV, self.get_read_version)
         process.register(Tokens.COMMIT, self.commit)
         process.register(Tokens.GET_KEY_SERVERS, self.get_key_servers)
         process.spawn(self.batcher_loop())
+
+    def register_instance(self, process) -> None:
+        """Endpoints only — the hosting worker owns the batcher actor."""
+        self.process = process
+        process.register(f"{Tokens.GRV}#{self.uid}", self.get_read_version)
+        process.register(f"{Tokens.COMMIT}#{self.uid}", self.commit)
+        process.register(f"{Tokens.GET_KEY_SERVERS}#{self.uid}", self.get_key_servers)
+        process.register(f"proxy.ping#{self.uid}", self._ping)
+
+    async def _ping(self, _req):
+        self._check_alive()
+        return "pong"
 
 
 # -- helpers ------------------------------------------------------------------

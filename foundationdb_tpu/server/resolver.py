@@ -26,10 +26,18 @@ from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
 
 
 class Resolver:
-    def __init__(self, knobs: Knobs = None, backend: str = "oracle", **backend_kw):
+    def __init__(
+        self,
+        knobs: Knobs = None,
+        backend: str = "oracle",
+        first_version: Version = 0,
+        uid: str = "",
+        **backend_kw,
+    ):
         self.knobs = knobs or Knobs()
         self.cs = new_conflict_set(backend, **backend_kw)
-        self.gate = VersionGate(0)
+        self.gate = VersionGate(first_version)
+        self.uid = uid
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
 
@@ -79,3 +87,10 @@ class Resolver:
 
     def register(self, process) -> None:
         process.register(Tokens.RESOLVE, self.resolve)
+
+    def register_instance(self, process) -> None:
+        process.register(f"{Tokens.RESOLVE}#{self.uid}", self.resolve)
+        process.register(f"resolver.ping#{self.uid}", self._ping)
+
+    async def _ping(self, _req):
+        return "pong"

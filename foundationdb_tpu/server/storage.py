@@ -1,10 +1,18 @@
 """Storage server role: the MVCC read node.
 
 The analog of fdbserver/storageserver.actor.cpp: pulls its tag's mutation
-stream from the tlog (update:2321), applies it in version order to the
-VersionedMap MVCC window, serves version-gated reads (getValueQ:680,
-getKeyValues:1180, waitForVersion:627), and periodically advances durability
-— here, compacting the window and popping the tlog (updateStorage:2536).
+stream from the log system (update:2321) through a cross-generation
+PeekCursor, applies it in version order to the VersionedMap MVCC window,
+serves version-gated reads (getValueQ:680, getKeyValues:1180,
+waitForVersion:627), and periodically advances durability — compacting the
+window and popping the tlogs (updateStorage:2536).
+
+Storage servers outlive master recoveries: when a new epoch's config
+arrives, the server rolls back any versions beyond the old generation's end
+version (rollback:2172 — data it pulled from a tlog whose tail didn't make
+the recovery cut; clients never read those versions because GRVs only ever
+return committed versions, which are ≤ every epoch-end) and then continues
+pulling from the new generation's tlogs.
 """
 
 from __future__ import annotations
@@ -15,42 +23,79 @@ from ..kv.mutations import MutationType
 from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, wait_for_any
 from ..runtime.knobs import Knobs
-from ..runtime.trace import SevInfo, trace
+from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
     GetKeyValuesReply,
     GetKeyValuesRequest,
     GetValueReply,
     GetValueRequest,
-    TLogPeekRequest,
-    TLogPopRequest,
     Tokens,
     Version,
 )
+from .log_system import PeekCursor
 
 WAIT_FOR_VERSION_TIMEOUT = 1.0  # then future_version (client retries the read)
 
 
 class StorageServer:
-    def __init__(self, tag: int, tlog_ep, knobs: Knobs = None):
+    def __init__(
+        self,
+        tag: int,
+        log_config: AsyncVar,  # AsyncVar[LogSystemConfig]
+        knobs: Knobs = None,
+        uid: str = "",
+    ):
         self.tag = tag
-        self.tlog_ep = tlog_ep
+        self.log_config = log_config
         self.knobs = knobs or Knobs()
+        self.uid = uid
         self.data = VersionedMap()
         self.version = AsyncVar(0)
         self.durable_version = 0
+        self._followed_epoch = -1
         self.process = None
+        self._cursor = None
 
     # -- mutation pull loop (update:2321) --------------------------------------
 
     async def pull_loop(self):
+        self._cursor = PeekCursor(self.process, self.tag, self.log_config)
         while True:
-            req = TLogPeekRequest(tag=self.tag, begin=self.version.get() + 1)
-            reply = await self.process.request(self.tlog_ep, req)
-            for version, mutations in reply.messages:
+            self._maybe_rollback()
+            messages, end = await self._cursor.next(self.version.get())
+            self._maybe_rollback()  # config may have flipped while parked
+            for version, mutations in messages:
+                if version <= self.version.get():
+                    continue  # already applied (replica failover overlap)
                 for m in mutations:
                     self._apply(m, version)
-            if reply.end_version > self.version.get():
-                self.version.set(reply.end_version)
+            if end > self.version.get():
+                self.version.set(end)
+
+    def _maybe_rollback(self) -> None:
+        """On an epoch change, cut back to the old generation's end version
+        (see module doc)."""
+        cfg = self.log_config.get()
+        if cfg is None or cfg.epoch == self._followed_epoch:
+            return
+        if self._followed_epoch >= 0:
+            boundary = None
+            for old in cfg.old:
+                if old.set.epoch == self._followed_epoch:
+                    boundary = old.end_version
+                    break
+            if boundary is not None and self.version.get() > boundary:
+                trace(
+                    SevWarn,
+                    "StorageRollback",
+                    self.process.address if self.process else "ss",
+                    Tag=self.tag,
+                    From=self.version.get(),
+                    To=boundary,
+                )
+                self.data.rollback_after(boundary)
+                self.version.set(boundary)
+        self._followed_epoch = cfg.epoch
 
     def _apply(self, m, version: Version) -> None:
         if m.type == MutationType.SET_VALUE:
@@ -78,9 +123,8 @@ class StorageServer:
             if new_durable > self.durable_version:
                 self.durable_version = new_durable
                 self.data.forget_before(new_durable)
-                await self.process.request(
-                    self.tlog_ep, TLogPopRequest(tag=self.tag, upto=self.version.get())
-                )
+            if self._cursor is not None:
+                await self._cursor.pop(self.version.get())
 
     # -- version gate (waitForVersion:627) -------------------------------------
 
@@ -109,10 +153,25 @@ class StorageServer:
 
     # -- wiring ----------------------------------------------------------------
 
-    def register(self, process) -> None:
+    async def _get_version(self, _req):
+        """(version, followed_epoch): the epoch qualifies the version — a
+        raw version may still include a pre-recovery tail this server has
+        not rolled back yet (it only rolls back once it sees the new
+        epoch's config), so catch-up decisions must check the epoch too."""
+        return (self.version.get(), self._followed_epoch)
+
+    def register_endpoints(self, process) -> None:
         self.process = process
         process.register(Tokens.GET_VALUE, self.get_value)
         process.register(Tokens.GET_KEY_VALUES, self.get_key_values)
+        process.register(f"storage.version#{self.uid}", self._get_version)
+        process.register(f"storage.ping#{self.uid}", self._ping)
+        trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
+
+    def register(self, process) -> None:
+        self.register_endpoints(process)
         process.spawn(self.pull_loop())
         process.spawn(self.durability_loop())
-        trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
+
+    async def _ping(self, _req):
+        return "pong"

@@ -1,10 +1,17 @@
-"""TLog role: the durable, tag-indexed write-ahead log.
+"""TLog role: the durable, tag-indexed write-ahead log of one epoch.
 
 The analog of fdbserver/TLogServer.actor.cpp: commits arrive in version order
-(prev_version chaining, like the resolver — tLogCommit:1115 waits on the same
-kind of sequencing), are indexed by tag in memory (LogData:304), and are
-served to storage servers as per-tag streams (tLogPeekMessages:903) with
-long-polling; acked data is trimmed by pop (tLogPop:861).
+(prev_version chaining — tLogCommit:1115 waits on the same kind of
+sequencing), are indexed by tag in memory (LogData:304), and are served to
+storage servers as per-tag streams (tLogPeekMessages:903) with long-polling;
+acked data is trimmed by pop (tLogPop:861).
+
+Epoch fencing (tLogLock:467): a recovering master locks the tlog with its
+higher epoch; a locked tlog rejects further commits — acks already sent
+stand (that data is durable and counted by recovery), but nothing new from
+the fenced epoch's proxies can become committed. The lock reply carries the
+durable version; min over locked replicas = the epoch's end version
+(see log_system.py).
 
 Durability here is modeled (a simulated fsync delay before the ack — the
 DiskQueue push+sync of doQueueCommit:1045); the native DiskQueue-backed
@@ -18,33 +25,56 @@ from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from .interfaces import (
     TLogCommitRequest,
+    TLogLockReply,
+    TLogLockRequest,
     TLogPeekReply,
     TLogPeekRequest,
     TLogPopRequest,
-    Tokens,
     Version,
 )
 
 FSYNC_TIME = 0.0005  # simulated DiskQueue sync
 
 
+class TLogStopped(Exception):
+    """Commit to a locked (fenced) tlog — the reference's tlog_stopped."""
+
+
 class TLog:
-    def __init__(self, knobs: Knobs = None, tags: frozenset = None):
+    def __init__(
+        self,
+        knobs: Knobs = None,
+        tags: frozenset = None,
+        epoch: int = 0,
+        log_id: str = "",
+        first_version: Version = 0,
+    ):
         self.knobs = knobs or Knobs()
         self.tags = tags  # tags this tlog stores; None = all
+        self.epoch = epoch
+        self.log_id = log_id
+        self.stopped = False  # locked by a higher-epoch master
+        self.locked_by_epoch = -1
         # ascending [(version, {tag: [mutations]})]
         self._log: list[tuple[Version, dict]] = []
         self._versions: list[Version] = []  # parallel index for bisect
-        self.version = AsyncVar(0)  # highest *durable* (fsynced) version
-        self._gate = VersionGate(0)  # commit sequencing
+        self.version = AsyncVar(first_version)  # highest *durable* version
+        self.known_committed = first_version  # proxy-reported committed
+        self._gate = VersionGate(first_version)  # commit sequencing
         # version → durability future while an append+fsync is in flight;
         # duplicates await it instead of acking early
         self._pending: dict[Version, Future] = {}
         self._popped: dict[int, Version] = {}  # tag → popped-through version
 
     async def commit(self, req: TLogCommitRequest):
+        if self.stopped:
+            raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
         # version-ordered application (same chain discipline as the resolver)
         await self._gate.wait_until(req.prev_version)
+        if self.stopped:
+            # fenced while waiting: must not make this durable/acked — the
+            # recovery already chose an end version without it
+            raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
         if req.version <= self._gate.version:
             return None  # duplicate (proxy retransmit): already durable
         dup = self._pending.get(req.version)
@@ -76,14 +106,32 @@ class TLog:
                 from ..runtime.loop import Cancelled
 
                 durable._set_error(Cancelled())
+        if self.stopped:
+            # durable, but past the fence: never ack (the client sees
+            # commit_unknown_result; peeks may serve it but the cursor
+            # clamps at the epoch end version)
+            raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
         self._gate.advance_to(req.version)
+        if req.known_committed > self.known_committed:
+            self.known_committed = req.known_committed
         if req.version > self.version.get():
             self.version.set(req.version)
         return None
 
+    async def lock(self, req: TLogLockRequest) -> TLogLockReply:
+        """Fence this tlog for recovery by a higher epoch (tLogLock:467)."""
+        if req.epoch > self.epoch and req.epoch > self.locked_by_epoch:
+            self.stopped = True
+            self.locked_by_epoch = req.epoch
+            self.version.set(self.version.get())  # wake parked peeks
+        return TLogLockReply(
+            end_version=self.version.get(), known_committed=self.known_committed
+        )
+
     async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
-        # long-poll: wait until data through req.begin exists
-        while self.version.get() < req.begin:
+        # long-poll: wait until data through req.begin exists (a stopped
+        # tlog's horizon is final — reply immediately with what it has)
+        while self.version.get() < req.begin and not self.stopped:
             await self.version.on_change()
         durable = self.version.get()
         i = bisect.bisect_left(self._versions, req.begin)
@@ -118,7 +166,14 @@ class TLog:
             del self._log[:i]
             del self._versions[:i]
 
-    def register(self, process) -> None:
-        process.register(Tokens.TLOG_COMMIT, self.commit)
-        process.register(Tokens.TLOG_PEEK, self.peek)
-        process.register(Tokens.TLOG_POP, self.pop)
+    def register_instance(self, process) -> None:
+        """Id-suffixed tokens: many generations can share a worker."""
+        process.register(f"tlog.commit#{self.log_id}", self.commit)
+        process.register(f"tlog.peek#{self.log_id}", self.peek)
+        process.register(f"tlog.pop#{self.log_id}", self.pop)
+        process.register(f"tlog.lock#{self.log_id}", self.lock)
+        process.register(f"tlog.ping#{self.log_id}", _pong)
+
+
+async def _pong(_req):
+    return "pong"

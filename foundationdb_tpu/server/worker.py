@@ -1,0 +1,304 @@
+"""Worker: the process shell that hosts roles on recruitment.
+
+The analog of fdbserver/worker.actor.cpp: every fdbd process runs a worker
+that (a) campaigns for cluster controllership (tryBecomeLeader — in the
+reference the worker's monitorLeader/candidacy split), (b) registers itself
+with the elected CC and keeps the registration alive (registrationClient:253
+— the lease doubles as failure detection), (c) instantiates roles when the
+CC or master asks (workerServer:481, role dispatch :693-794), and (d)
+receives ServerDBInfo broadcasts, garbage-collecting role instances from
+dead epochs.
+
+Storage roles are immortal here (they carry data); everything else belongs
+to an epoch and is destroyed once the recovery_count moves past it — except
+tlogs, which live until no generation in the log-system config references
+them (old generations serve storage catch-up after recovery).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..net.sim import Endpoint
+from ..runtime.futures import AsyncVar, delay, timeout
+from ..runtime.knobs import Knobs
+from ..runtime.trace import SevInfo, SevWarn, trace
+from .coordination import LeaderInfo, monitor_leader, try_become_leader
+from .interfaces import (
+    RecruitRoleReply,
+    RecruitRoleRequest,
+    RegisterWorkerRequest,
+    SetDBInfoRequest,
+    Tokens,
+)
+
+
+@dataclass
+class _RoleHandle:
+    kind: str
+    uid: str
+    epoch: int = 0
+    tokens: list = field(default_factory=list)
+    actors: list = field(default_factory=list)
+    obj: object = None
+
+
+class Worker:
+    def __init__(
+        self,
+        process,
+        coordinators: list[str],
+        process_class: str = "unset",
+        initial_config: dict = None,
+        can_be_cc: bool = True,
+        knobs: Knobs = None,
+    ):
+        self.process = process
+        self.coordinators = coordinators
+        self.process_class = process_class
+        self.initial_config = initial_config or {}
+        self.can_be_cc = can_be_cc
+        self.knobs = knobs or process.sim.knobs
+        self.db_info = AsyncVar(None)  # ServerDBInfo broadcast
+        self.log_config = AsyncVar(None)  # LogSystemConfig for storage roles
+        self.leader = AsyncVar(None)  # LeaderInfo of the current CC
+        self.roles: dict[str, _RoleHandle] = {}
+        self._cc = None  # ClusterController when we hold the leadership
+
+    # -- boot ------------------------------------------------------------------
+
+    def start(self) -> None:
+        p = self.process
+        p.worker = self  # test/ops introspection (the worker IS the process)
+        p.register(Tokens.WORKER_RECRUIT, self.recruit)
+        p.register(Tokens.WORKER_SET_DB_INFO, self.set_db_info)
+        p.register(Tokens.WORKER_PING, self._ping)
+        p.spawn(monitor_leader(p, self.coordinators, self.leader))
+        p.spawn(self._registration_client())
+        if self.can_be_cc:
+            p.spawn(self._cc_campaign())
+
+    async def _ping(self, _req):
+        return "pong"
+
+    # -- registration (registrationClient, worker.actor.cpp:253) ---------------
+
+    async def _registration_client(self):
+        while True:
+            leader = self.leader.get()
+            if leader is not None:
+                try:
+                    await timeout(
+                        self.process.request(
+                            Endpoint(leader.address, Tokens.CC_REGISTER_WORKER),
+                            RegisterWorkerRequest(
+                                address=self.process.address,
+                                process_class=self.process_class,
+                                roles=tuple(h.kind for h in self.roles.values()),
+                            ),
+                        ),
+                        self.knobs.HEARTBEAT_INTERVAL * 2,
+                    )
+                except Exception:
+                    pass
+            await delay(self.knobs.HEARTBEAT_INTERVAL)
+
+    # -- CC candidacy ----------------------------------------------------------
+
+    async def _cc_campaign(self):
+        from .cluster_controller import ClusterController
+
+        change_id = 0
+        while True:
+            change_id += 1
+            info = LeaderInfo(
+                address=self.process.address,
+                priority=1 if self.process_class == "stateless" else 0,
+                change_id=self.process.sim.loop.random.random_int(1, 1 << 30)
+                * 4
+                + self.process.reboots % 4,
+            )
+            leadership = await try_become_leader(
+                self.process, self.coordinators, info
+            )
+            trace(SevInfo, "BecameClusterController", self.process.address)
+            cc = ClusterController(
+                self.process,
+                self.coordinators,
+                initial_config=self.initial_config,
+                knobs=self.knobs,
+            )
+            self._cc = cc
+            cc.start()
+            await leadership.lost
+            trace(SevWarn, "LostClusterControllership", self.process.address)
+            cc.shutdown()
+            self._cc = None
+
+    # -- ServerDBInfo broadcast -------------------------------------------------
+
+    async def set_db_info(self, req: SetDBInfoRequest):
+        info = req.info
+        cur = self.db_info.get()
+        if cur is not None and info.id <= cur.id:
+            return None
+        self.db_info.set(info)
+        self.log_config.set(info.log_system)
+        self._gc_roles(info)
+        return None
+
+    def _gc_roles(self, info) -> None:
+        """Destroy role instances from epochs before info.recovery_count;
+        tlogs live while any generation references their log_id."""
+        live_logs = set()
+        if info.log_system is not None:
+            for log in info.log_system.current.logs:
+                live_logs.add(log.log_id)
+            for old in info.log_system.old:
+                for log in old.set.logs:
+                    live_logs.add(log.log_id)
+        for uid, h in list(self.roles.items()):
+            if h.kind == "storage":
+                continue
+            if h.kind == "tlog":
+                if h.uid not in live_logs and h.epoch < info.recovery_count:
+                    self._destroy(uid)
+            elif h.epoch < info.recovery_count:
+                self._destroy(uid)
+
+    def _destroy(self, uid: str) -> None:
+        h = self.roles.pop(uid, None)
+        if h is None:
+            return
+        for token in h.tokens:
+            self.process.endpoints.pop(token, None)
+        # roles may register uid-suffixed endpoints asynchronously after
+        # recruitment returned (the master does, mid-recovery) — sweep them
+        for token in [t for t in self.process.endpoints if t.endswith(f"#{uid}")]:
+            self.process.endpoints.pop(token, None)
+        for a in h.actors:
+            a.cancel()
+        trace(
+            SevInfo, "RoleDestroyed", self.process.address, Kind=h.kind, Uid=h.uid
+        )
+
+    # -- recruitment (workerServer role dispatch :693-794) ----------------------
+
+    async def recruit(self, req: RecruitRoleRequest) -> RecruitRoleReply:
+        if req.uid in self.roles:
+            return RecruitRoleReply(address=self.process.address, uid=req.uid)
+        maker = getattr(self, f"_make_{req.role}", None)
+        assert maker is not None, f"unknown role {req.role!r}"
+        before = set(self.process.endpoints)
+        h = _RoleHandle(kind=req.role, uid=req.uid)
+        self.roles[req.uid] = h
+        maker(h, **req.params)
+        h.tokens = [t for t in self.process.endpoints if t not in before]
+        trace(
+            SevInfo,
+            "RoleRecruited",
+            self.process.address,
+            Kind=req.role,
+            Uid=req.uid,
+        )
+        return RecruitRoleReply(address=self.process.address, uid=req.uid)
+
+    # one _make_* per role kind; each registers endpoints + spawns actors
+    # into the handle so _destroy can unwind them.
+
+    def _spawn(self, h: _RoleHandle, coro):
+        fut = self.process.spawn(coro)
+        h.actors.append(fut)
+        return fut
+
+    def _make_tlog(self, h, epoch=0, tags=None, first_version=0):
+        from .tlog import TLog
+
+        tl = TLog(
+            self.knobs,
+            tags=tags,
+            epoch=epoch,
+            log_id=h.uid,
+            first_version=first_version,
+        )
+        h.epoch, h.obj = epoch, tl
+        tl.register_instance(self.process)
+
+    def _make_resolver(self, h, backend="oracle", first_version=0, epoch=0):
+        from .resolver import Resolver
+
+        r = Resolver(
+            self.knobs, backend=backend, first_version=first_version, uid=h.uid
+        )
+        h.epoch, h.obj = epoch, r
+        r.register_instance(self.process)
+
+    def _make_proxy(
+        self,
+        h,
+        master=None,
+        resolver_map=None,
+        log_system=None,
+        shards=None,
+        epoch=0,
+        recovery_version=0,
+    ):
+        from .proxy import Proxy
+
+        pr = Proxy(
+            master=master,
+            resolver_map=resolver_map,
+            log_system=log_system,
+            shards=shards,
+            knobs=self.knobs,
+            epoch=epoch,
+            recovery_version=recovery_version,
+            uid=h.uid,
+        )
+        h.epoch, h.obj = epoch, pr
+        pr.register_instance(self.process)
+        self._spawn(h, pr.batcher_loop())
+
+    def _make_storage(self, h, tag=0):
+        from .storage import StorageServer
+
+        # storage keeps well-known data tokens: strictly one per process
+        # (a second would shadow the first's endpoints)
+        others = [x for x in self.roles.values() if x.kind == "storage" and x is not h]
+        if others:
+            del self.roles[h.uid]
+            raise RuntimeError(f"{self.process.address} already hosts storage")
+        ss = StorageServer(
+            tag=tag, log_config=self.log_config, knobs=self.knobs, uid=h.uid
+        )
+        h.obj = ss
+        ss.register_endpoints(self.process)
+        self._spawn(h, ss.pull_loop())
+        self._spawn(h, ss.durability_loop())
+
+    def _make_master(self, h, coordinators=None, cc_address="", initial_config=None):
+        from .master import MasterTerminated, master_core
+
+        async def run():
+            try:
+                await master_core(
+                    self.process,
+                    h.uid,
+                    coordinators or self.coordinators,
+                    cc_address,
+                    initial_config or self.initial_config,
+                )
+            except Exception as e:
+                trace(
+                    SevWarn,
+                    "MasterTerminated",
+                    self.process.address,
+                    Uid=h.uid,
+                    Reason=repr(e),
+                )
+            finally:
+                # master endpoints must vanish so the CC's ping sees death
+                self._destroy(h.uid)
+
+        h.epoch = 1 << 60  # destroyed by its own exit or GC on recovery bump
+        self._spawn(h, run())
