@@ -44,14 +44,15 @@ class Database:
         proxy_addrs: list[str] = None,
         client_addr: str = "client",
         coordinators: list[str] = None,
+        proxy_ifaces: list = None,  # explicit ProxyInterface list (e.g. DD)
     ):
         self.sim = sim
         self.knobs: Knobs = sim.knobs
         self.client = sim.processes.get(client_addr) or sim.new_process(client_addr)
         self.rng = sim.loop.random.fork()
-        self._proxies: AsyncVar = AsyncVar(
-            [ProxyInterface(a) for a in proxy_addrs] if proxy_addrs else None
-        )
+        if proxy_ifaces is None and proxy_addrs is not None:
+            proxy_ifaces = [ProxyInterface(a) for a in proxy_addrs]
+        self._proxies: AsyncVar = AsyncVar(proxy_ifaces)
         # location cache: key range → team addresses (None = unknown)
         self._locations = KeyRangeMap(default=None)
         if coordinators:

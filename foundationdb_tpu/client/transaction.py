@@ -27,6 +27,7 @@ from ..errors import (
     FutureVersion,
     NotCommitted,
     TransactionTooOld,
+    WrongShardServer,
 )
 from ..kv.atomic import apply_atomic
 from ..kv.keyrange_map import KeyRangeMap
@@ -290,7 +291,10 @@ class Transaction:
         return reply.data, None
 
     async def _load_balanced(self, key: bytes, token: str, req):
-        """Replica selection with retry — LoadBalance.actor.h:158."""
+        """Replica selection with retry — LoadBalance.actor.h:158.
+        wrong_shard_server (a replica that moved the shard away, or a move
+        destination still fetching) tries the next replica, then refreshes
+        the location cache — NativeAPI's handling in getValue/getRange."""
         version_retries = 0
         last_err: Exception = None
         for attempt in range(MAX_READ_ATTEMPTS):
@@ -301,7 +305,7 @@ class Transaction:
                 ep = Endpoint(team[i], token)
                 try:
                     return await self.db.client.request(ep, req)
-                except BrokenPromise as e:
+                except (BrokenPromise, WrongShardServer) as e:
                     last_err = e
                     continue
                 except FutureVersion as e:
@@ -313,7 +317,8 @@ class Transaction:
                     raise last_err
                 await delay(FUTURE_VERSION_RETRY_DELAY)
             else:
-                # whole team unreachable: drop cache, back off, re-locate
+                # whole team unreachable or moved: drop cache, back off,
+                # re-locate
                 self.db.invalidate_cache(key)
                 await delay(0.1)
         raise last_err or BrokenPromise("read retries exhausted")

@@ -41,6 +41,14 @@ class KeyOutsideLegalRange(FdbError):
     pass
 
 
+class WrongShardServer(FdbError):
+    """Read sent to a storage server that doesn't (yet) own the shard
+    (error 1037 wrong_shard_server) — the client invalidates its location
+    cache and retries."""
+
+    retryable = True
+
+
 class AccessedUnreadable(FdbError):
     """Read of a key written with a versionstamp op this transaction
     (error 1036)."""

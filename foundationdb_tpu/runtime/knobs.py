@@ -11,6 +11,7 @@ from __future__ import annotations
 class Knobs:
     # commit pipeline
     COMMIT_BATCH_INTERVAL = 0.002  # proxy batch window (s)
+    MAX_COMMIT_BATCH_INTERVAL = 0.25  # idle proxies commit empty batches
     MAX_BATCH_TXNS = 4096
     VERSIONS_PER_SECOND = 1_000_000
     MAX_READ_TRANSACTION_LIFE_VERSIONS = 5_000_000  # the MVCC window (~5s)
@@ -26,6 +27,10 @@ class Knobs:
     # failure detection / recovery
     HEARTBEAT_INTERVAL = 0.5
     FAILURE_TIMEOUT = 2.0
+    # ratekeeper (admission control by worst storage version lag)
+    RK_MAX_TPS = 100_000.0
+    RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
+    RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
     # client
     GRV_BATCH_INTERVAL = 0.001
     CLIENT_MAX_RETRY_DELAY = 1.0

@@ -1,0 +1,183 @@
+"""DataDistribution + Ratekeeper: shard placement repair and admission
+control, run inside the master (as in the 6.0 reference —
+masterserver.actor.cpp hosts both).
+
+DataDistribution (fdbserver/DataDistribution.actor.cpp, simplified):
+- failure-monitors every storage server (storageServerTracker:1558);
+- walks the live shard map through the proxies' keyServers service and,
+  for any shard whose team lost a member, rebuilds the team from healthy
+  servers (fewest-shards-first — the spirit of DDTeamCollection's
+  team building) and relocates with the MoveKeys protocol;
+- also exposes the balance primitive tests/ManagementAPI drive directly
+  (movekeys.move_shard).
+Moves are serialized through one queue, like DataDistributionQueue's
+in-flight limit (here: 1).
+
+Ratekeeper (fdbserver/Ratekeeper.actor.cpp, simplified): computes a
+cluster transaction rate from the worst storage-server version lag (the
+"storage server write queue" signal — limitReason storage_server_write_-
+queue_size); proxies poll it (getRate, MasterProxyServer.actor.cpp:85)
+and gate GRVs through a token bucket, so client load backs off before
+the MVCC window is overrun.
+"""
+
+from __future__ import annotations
+
+from ..net.sim import Endpoint
+from ..runtime.futures import delay, timeout
+from ..runtime.trace import SevInfo, SevWarn, trace
+from .interfaces import GetKeyServersRequest, Tokens
+from .movekeys import move_shard
+
+
+class DataDistributor:
+    def __init__(self, process, db, storage, knobs, replication: int):
+        self.process = process
+        self.db = db  # Database over this epoch's proxies
+        self.storage = list(storage)  # [StorageInterface]
+        self.knobs = knobs
+        self.replication = replication
+        self.alive: dict[int, bool] = {s.tag: True for s in storage}
+
+    async def run(self):
+        monitor = self.process.spawn(self._failure_monitor())
+        try:
+            while True:
+                await delay(1.0)
+                try:
+                    await self._repair_once()
+                except Exception as e:
+                    trace(
+                        SevWarn, "DDRepairError", self.process.address, Err=repr(e)
+                    )
+        finally:
+            monitor.cancel()  # dies with this DD, not with the process
+
+    async def _failure_monitor(self):
+        misses = {s.tag: 0 for s in self.storage}
+        while True:
+            await delay(self.knobs.HEARTBEAT_INTERVAL)
+            for s in self.storage:
+                try:
+                    r = await timeout(
+                        self.process.request(s.ep("ping"), None),
+                        self.knobs.HEARTBEAT_INTERVAL * 2,
+                    )
+                    ok = r is not None
+                except Exception:
+                    ok = False
+                misses[s.tag] = 0 if ok else misses[s.tag] + 1
+                was = self.alive[s.tag]
+                now_alive = misses[s.tag] * self.knobs.HEARTBEAT_INTERVAL < (
+                    self.knobs.FAILURE_TIMEOUT
+                )
+                if was and not now_alive:
+                    trace(
+                        SevWarn,
+                        "DDStorageFailed",
+                        self.process.address,
+                        Tag=s.tag,
+                        Address=s.address,
+                    )
+                self.alive[s.tag] = now_alive
+
+    async def _walk_shards(self):
+        """[(begin, end, tags)] from the proxies' live keyInfo."""
+        out = []
+        key = b""
+        while True:
+            reply = await self.db._proxy_request(
+                Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+            )
+            out.append((reply.begin, reply.end, tuple(reply.tags)))
+            if reply.end is None:
+                return out
+            key = reply.end
+
+    async def _repair_once(self):
+        shards = await self._walk_shards()
+        load = {s.tag: 0 for s in self.storage}
+        for _b, _e, tags in shards:
+            for t in tags:
+                if t in load:
+                    load[t] += 1
+        by_tag = {s.tag: s for s in self.storage}
+        for begin, end, tags in shards:
+            dead = [t for t in tags if not self.alive.get(t, False)]
+            if not dead:
+                continue
+            healthy = [t for t in tags if self.alive.get(t, False)]
+            candidates = sorted(
+                (
+                    t
+                    for t, up in self.alive.items()
+                    if up and t not in tags
+                ),
+                key=lambda t: load[t],
+            )
+            need = max(self.replication - len(healthy), 0)
+            if need > len(candidates):
+                trace(
+                    SevWarn,
+                    "DDNoReplacement",
+                    self.process.address,
+                    Begin=begin,
+                    Need=need,
+                )
+                continue
+            # cap at the replication factor: a mid-move union team (src ∪
+            # dest) must not be finalized as an over-replicated team
+            new_tags = (healthy + candidates[:need])[: self.replication]
+            if not new_tags:
+                continue
+            trace(
+                SevInfo,
+                "DDRelocating",
+                self.process.address,
+                Begin=begin,
+                From=tags,
+                To=tuple(new_tags),
+            )
+            await move_shard(self.db, begin, end, [by_tag[t] for t in new_tags])
+            for t in candidates[:need]:
+                load[t] += 1
+
+
+class Ratekeeper:
+    """Version-lag-driven admission control (updateRate, simplified)."""
+
+    def __init__(self, process, master, storage, knobs, uid: str):
+        self.process = process
+        self.master = master  # the Master (version authority) instance
+        self.storage = list(storage)
+        self.knobs = knobs
+        self.rate = float(self.knobs.RK_MAX_TPS)
+        process.register(f"master.getRate#{uid}", self.get_rate)
+
+    async def get_rate(self, _req) -> float:
+        return self.rate
+
+    async def run(self):
+        while True:
+            await delay(0.5)
+            lags = []
+            for s in self.storage:
+                try:
+                    r = await timeout(self.process.request(s.ep("version"), None), 0.5)
+                except Exception:
+                    continue
+                if r is not None:
+                    version, _epoch = r
+                    lags.append(self.master.last_assigned - version)
+            if not lags:
+                continue
+            worst = max(lags)
+            lo = self.knobs.RK_LAG_TARGET
+            hi = self.knobs.RK_LAG_MAX
+            if worst <= lo:
+                factor = 1.0
+            elif worst >= hi:
+                factor = 0.05  # never fully zero: progress drains the lag
+            else:
+                factor = max(0.05, 1.0 - (worst - lo) / (hi - lo))
+            self.rate = self.knobs.RK_MAX_TPS * factor

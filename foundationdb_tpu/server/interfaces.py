@@ -87,10 +87,11 @@ class GetKeyServersRequest:
 
 @dataclass
 class GetKeyServersReply:
-    # (shard_begin, shard_end, [storage addresses])
+    # (shard_begin, shard_end, [storage addresses], [storage tags])
     begin: bytes = b""
     end: Optional[bytes] = None
     team: list[str] = field(default_factory=list)
+    tags: list = None
 
 
 # -- resolver (ResolverInterface.h / ResolveTransactionBatchRequest) ----------
@@ -103,11 +104,20 @@ class ResolveBatchRequest:
     last_receive_version: Version = INVALID_VERSION
     requesting_proxy: str = ""
     transactions: list[TransactionData] = field(default_factory=list)
+    # indices (into transactions) of system-keyspace txns; resolver 0's
+    # copies carry the metadata mutations (ResolutionRequestBuilder's
+    # txnStateTransactions, MasterProxyServer.actor.cpp:302-305)
+    state_txn_indices: list[int] = field(default_factory=list)
 
 
 @dataclass
 class ResolveBatchReply:
     committed: list[int] = field(default_factory=list)  # Verdict per txn
+    # state txns for every version in (last_receive_version, version]:
+    # [(version, [(committed: bool, mutations)])] — this resolver's verdict;
+    # the proxy ANDs the flags across resolvers and applies resolver 0's
+    # mutation bytes (commitBatch phase 3, MasterProxyServer:432-450)
+    state_mutations: list = field(default_factory=list)
 
 
 # -- tlog (TLogInterface.h) ---------------------------------------------------
@@ -207,6 +217,7 @@ class MasterInterface:
             "getCommitVersion": Tokens.GET_COMMIT_VERSION,
             "reportCommitted": Tokens.REPORT_COMMITTED,
             "getLiveCommitted": Tokens.GET_LIVE_COMMITTED,
+            "getRate": "master.getRate",
             "ping": "master.ping",
         }[method]
         return Endpoint(self.address, _suffixed(token, self.uid))

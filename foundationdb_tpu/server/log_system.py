@@ -108,9 +108,15 @@ class LogSystem:
         (the push quorum — all replicas of every tag, see module doc)."""
         from .interfaces import TLogCommitRequest
 
+        from .systemdata import TXS_TAG
+
         pushes = []
         for log in self.tlog_set.logs:
-            msgs = {t: ms for t, ms in to_log.items() if t in log.tags}
+            # the txs (transaction-state) tag rides on EVERY tlog so any
+            # locked replica can rebuild the shard map at recovery
+            msgs = {
+                t: ms for t, ms in to_log.items() if t in log.tags or t == TXS_TAG
+            }
             pushes.append(
                 process.request(
                     log.ep("commit"),

@@ -140,6 +140,8 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     recruited; the worker deregisters our endpoints then."""
     from .proxy import Proxy, ShardMap
     from .log_system import LogSystem
+    from .interfaces import TLogPeekRequest
+    from .systemdata import TXS_TAG, apply_metadata_mutations
 
     # the CC failure-detects us from the moment of recruitment — the ping
     # endpoint must exist before any slow recovery step, or a recovery
@@ -207,14 +209,32 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     tlog_replication = int(config.get("tlog_replication", 1))
     backend = config.get("conflict_backend", "oracle")
 
-    # storage: seeded once on a brand-new database, then immortal
+    # storage: seeded once on a brand-new database, then immortal.
+    # The live shard map = the coordinated-state snapshot + the txs-tag
+    # deltas logged since (readTransactionSystemState — the reference's
+    # txnStateStore recovery from the log system).
     if prev:
         storage = list(prev.storage)
-        shards = list(prev.shards)
+        shard_map = ShardMap.from_list(prev.shards)
+        for log in prev.tlog_set.logs:
+            if log.log_id not in locks:
+                continue
+            try:
+                reply = await process.request(
+                    log.ep("peek"), TLogPeekRequest(tag=TXS_TAG, begin=1)
+                )
+            except Exception:
+                continue
+            for v, muts in reply.messages:
+                if v <= recovery_version:
+                    apply_metadata_mutations(shard_map, muts)
+            break  # txs rides every tlog; any locked one is complete
+        shards = shard_map.to_list()
     else:
         storage, shards = await _seed_storage(
             process, picker, n_storage, replication, uid
         )
+        shard_map = ShardMap.from_list(shards)
 
     # new tlog generation (uids carry the master uid: a failed prior
     # attempt at this recovery_count must not collide)
@@ -268,14 +288,11 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     master.register_instance(process)
     master_iface = MasterInterface(address=process.address, uid=uid)
 
-    # proxies (they need everything above)
+    # proxies (they need everything above; each copies the shard map)
     resolver_map = KeyRangeMap()
     rbounds = [b""] + _split_points(n_resolvers) + [None]
     for i, iface in enumerate(resolver_ifaces):
         resolver_map.insert(rbounds[i], rbounds[i + 1], iface)
-    shard_map = ShardMap()
-    for begin, end, addrs, tags in shards:
-        shard_map.set_shard(begin, end, addrs, tags)
 
     proxy_workers = picker.pick("proxy", n_proxies)
     proxy_ifaces = []
@@ -335,19 +352,36 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         RecoveryVersion=recovery_version,
     )
 
-    # service: watch for role failure; drop old tlog generations when safe
+    # service: watch for role failure; drop old tlog generations when safe;
+    # run DataDistribution + Ratekeeper (hosted in the master, as in 6.0)
+    from ..client.database import Database
+    from .data_distribution import DataDistributor, Ratekeeper
+
+    knobs = process.sim.knobs
+    dd_db = Database(
+        process.sim, client_addr=process.address, proxy_ifaces=list(proxy_ifaces)
+    )
+    dd = DataDistributor(
+        process, dd_db, storage, knobs, int(config.get("replication", 1))
+    )
+    rk = Ratekeeper(process, master, storage, knobs, uid)
     watched = (
         [(i.ep("ping"), "proxy") for i in proxy_ifaces]
         + [(i.ep("ping"), "resolver") for i in resolver_ifaces]
         + [(log.ep("ping"), "tlog") for log in tlog_set.logs]
     )
-    track = process.spawn(
-        _track_tlog_recovery(process, cs, core, info, cc_address, storage)
-    )
+    aux = [
+        process.spawn(
+            _track_tlog_recovery(process, cs, core, info, cc_address, storage)
+        ),
+        process.spawn(dd.run()),
+        process.spawn(rk.run()),
+    ]
     try:
         await _wait_failure(process, watched)
     finally:
-        track.cancel()
+        for a in aux:
+            a.cancel()
     raise MasterTerminated("a recruited role failed")
 
 
@@ -411,21 +445,24 @@ async def _seed_storage(process, picker: _RolePicker, n_storage, replication, m_
     assert len({w.address for w in workers}) == len(workers), (
         "storage roles need distinct workers (one per process)"
     )
-    storage = []
-    for tag, w in enumerate(workers):
-        s_uid = f"ss-{tag}"
-        await process.request(
-            Endpoint(w.address, Tokens.WORKER_RECRUIT),
-            RecruitRoleRequest(role="storage", uid=s_uid, params=dict(tag=tag)),
-        )
-        storage.append(StorageInterface(address=w.address, uid=s_uid, tag=tag))
     n_teams = n_storage // replication
     bounds = [b""] + _split_points(n_teams) + [None]
     shards = []
     for team in range(n_teams):
         members = list(range(team * replication, (team + 1) * replication))
-        addrs = tuple(storage[t].address for t in members)
+        addrs = tuple(workers[t].address for t in members)
         shards.append((bounds[team], bounds[team + 1], addrs, tuple(members)))
+    storage = []
+    for tag, w in enumerate(workers):
+        s_uid = f"ss-{tag}"
+        ranges = [(b, e) for b, e, _a, tags in shards if tag in tags]
+        await process.request(
+            Endpoint(w.address, Tokens.WORKER_RECRUIT),
+            RecruitRoleRequest(
+                role="storage", uid=s_uid, params=dict(tag=tag, ranges=ranges)
+            ),
+        )
+        storage.append(StorageInterface(address=w.address, uid=s_uid, tag=tag))
     return storage, shards
 
 
@@ -468,19 +505,23 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
         return
     while True:
         await delay(1.0)
-        try:
-            replies = await wait_for_all(
-                [
-                    process.request(s.ep("version"), None)
-                    for s in storage
-                ]
-            )
-        except Exception:
-            continue
+        from ..runtime.futures import settled, wait_for_any
+
+        futs = [process.request(s.ep("version"), None) for s in storage]
+        deadline = delay(2.0)
+        replies = []
+        for f in futs:
+            await wait_for_any([settled(f), deadline])
+            if f.is_ready() and not f.is_error():
+                replies.append(f.get())
         # a server counts as caught up only once it follows THIS epoch:
         # before that its version may contain a discarded pre-recovery
-        # tail it hasn't rolled back yet
-        if all(
+        # tail it hasn't rolled back yet. Unreachable servers don't pin
+        # the old generation — a dead one never returns with its memory,
+        # and DD re-replicates its shards (a long partition risks leaving
+        # such a server permanently behind; the reference's per-server
+        # popping is future work).
+        if replies and all(
             epoch == core.recovery_count and version > core.recovery_version
             for version, epoch in replies
         ):

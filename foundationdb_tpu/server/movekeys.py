@@ -1,0 +1,112 @@
+"""MoveKeys: the two-phase shard relocation protocol.
+
+The analog of fdbserver/MoveKeys.actor.cpp: shard placement changes are
+ordinary transactions on ``\\xff/keyServers/``, made safe by the metadata
+machinery (every proxy applies them in version order; affected storage
+servers get privatized copies in their streams):
+
+- **start** (startMoveKeys): write the shard's entry with the *union* team
+  (src ∪ dest), old_* = src. Destinations see their tag appear and begin
+  fetchKeys from the sources; sources keep serving.
+- wait until every destination reports the range readable
+  (getShardState — waitForShardReady).
+- **finish** (finishMoveKeys): write the entry with the dest team only.
+  Sources see their tag removed and drop the range.
+
+Availability holds throughout: reads go to the union team during the move;
+destinations answer wrong_shard_server until ready and the client's load
+balancer falls over to a source.
+"""
+
+from __future__ import annotations
+
+from ..net.sim import Endpoint
+from ..runtime.futures import delay
+from .interfaces import GetKeyServersRequest, Tokens
+from .systemdata import key_servers_key, key_servers_value
+
+
+class MoveKeysError(Exception):
+    pass
+
+
+async def move_shard(
+    db,
+    begin: bytes,
+    end,
+    dest,
+    poll_interval: float = 0.2,
+    ready_timeout: float = 60.0,
+):
+    """Move [begin, end) to the team ``dest`` ([StorageInterface]).
+    The range must lie inside one current shard (DD moves shard by shard).
+    Returns when the move is complete and sources have been released.
+    Raises MoveKeysError if a destination never becomes ready (e.g. it
+    died mid-move) — the caller (DD) re-plans with a healthy team; the
+    union-team start state stays safe to re-move."""
+    reply = await db._proxy_request(
+        Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=begin)
+    )
+    if reply.tags is None:
+        raise MoveKeysError("proxy has no tag info for shard")
+    if not (reply.begin <= begin) or not (
+        reply.end is None or (end is not None and end <= reply.end)
+    ):
+        raise MoveKeysError("range crosses shard boundaries")
+    src_addrs, src_tags = tuple(reply.team), tuple(reply.tags)
+    dest_addrs = tuple(s.address for s in dest)
+    dest_tags = tuple(s.tag for s in dest)
+    if set(dest_tags) == set(src_tags):
+        return
+
+    union_addrs = tuple(dict.fromkeys(src_addrs + dest_addrs))
+    union_tags = tuple(dict.fromkeys(src_tags + dest_tags))
+
+    # phase 1: startMoveKeys — destinations begin fetching
+    async def start(tr):
+        tr.set(
+            key_servers_key(begin),
+            key_servers_value(
+                union_addrs, union_tags, old_addrs=src_addrs, old_tags=src_tags,
+                end=end,
+            ),
+        )
+
+    await db.run(start)
+
+    # wait for every (new) destination to become readable
+    from ..runtime.loop import now
+
+    new_tags = [t for t in dest_tags if t not in src_tags]
+    new_members = [s for s in dest if s.tag in new_tags]
+    deadline = now() + ready_timeout
+    for s in new_members:
+        while True:
+            try:
+                ready = await db.client.request(
+                    Endpoint(s.address, Tokens.GET_SHARD_STATE), (begin, end)
+                )
+                if ready:
+                    break
+            except Exception:
+                pass
+            if now() > deadline:
+                raise MoveKeysError(
+                    f"destination {s.address} (tag {s.tag}) never became ready"
+                )
+            await delay(poll_interval)
+
+    # phase 2: finishMoveKeys — sources release the range
+    async def finish(tr):
+        tr.set(
+            key_servers_key(begin),
+            key_servers_value(
+                dest_addrs,
+                dest_tags,
+                old_addrs=union_addrs,
+                old_tags=union_tags,
+                end=end,
+            ),
+        )
+
+    await db.run(finish)

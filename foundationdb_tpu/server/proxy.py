@@ -37,8 +37,21 @@ from ..errors import NotCommitted, TransactionTooOld
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
 from ..net.sim import BrokenPromise
-from ..runtime.futures import Future, delay, wait_for_all, wait_for_any
+from ..runtime.futures import (
+    AsyncTrigger,
+    Future,
+    VersionGate,
+    delay,
+    wait_for_all,
+    wait_for_any,
+)
 from ..runtime.knobs import Knobs
+from .systemdata import (
+    PRIVATE_PREFIX,
+    TXS_TAG,
+    apply_metadata_mutations,
+    is_metadata_mutation,
+)
 from .interfaces import (
     CommitReply,
     CommitRequest,
@@ -59,8 +72,9 @@ from .tlog import TLogStopped
 
 
 class ShardMap:
-    """Key → (team addresses, tags) map; the proxy's keyInfo
-    (ApplyMetadataMutation keeps this live in the reference)."""
+    """Key → (team addresses, tags) map; the proxy's keyInfo, kept live by
+    applying committed metadata mutations in version order
+    (ApplyMetadataMutation). Each proxy owns its own copy."""
 
     def __init__(self):
         self.map = KeyRangeMap(default=None)  # → (tuple(addresses), tuple(tags))
@@ -79,8 +93,21 @@ class ShardMap:
         return out
 
     def team_for_key(self, key: bytes):
+        """(begin, end, addresses, tags) of the shard containing key."""
         begin, end, v = self.map.range_for(key)
-        return begin, end, v[0]
+        return begin, end, v[0], v[1]
+
+    def to_list(self) -> list:
+        return [
+            (b, e, v[0], v[1]) for b, e, v in self.map.ranges() if v is not None
+        ]
+
+    @classmethod
+    def from_list(cls, shards) -> "ShardMap":
+        sm = cls()
+        for begin, end, addrs, tags in shards:
+            sm.set_shard(begin, end, addrs, tags)
+        return sm
 
 
 class ProxyDead(Exception):
@@ -93,7 +120,7 @@ class Proxy:
         master: MasterInterface,
         resolver_map: KeyRangeMap,  # key range → ResolverInterface
         log_system: LogSystem,
-        shards: ShardMap,
+        shards,  # ShardMap or [(begin, end, addrs, tags)] — copied either way
         knobs: Knobs = None,
         epoch: int = 0,
         recovery_version: Version = 0,
@@ -102,7 +129,9 @@ class Proxy:
         self.master = master
         self.resolver_map = resolver_map
         self.log_system = log_system
-        self.shards = shards
+        if isinstance(shards, ShardMap):
+            shards = shards.to_list()
+        self.shards = ShardMap.from_list(shards)  # own copy: mutated by echoes
         self.knobs = knobs or Knobs()
         self.epoch = epoch
         self.uid = uid
@@ -113,22 +142,65 @@ class Proxy:
         self._batch: list[tuple[TransactionData, Future]] = []
         self._batch_trigger: Future = Future()
         self._work: Future = Future()
+        # per-proxy batch sequencing: phase 1 (get version + send resolve)
+        # and phase 3 (apply state mutations + tag) each run in batch order
+        # (the latestLocalCommitBatchResolving/Logging gates, :353,415);
+        # everything between pipelines freely
+        self._local_batch = 0
+        self._resolving_gate = VersionGate(0)
+        self._logging_gate = VersionGate(0)
+        # ratekeeper gate state (None until a getRate reply arrives)
+        self._grv_budget = None
+        self._grv_replenished = AsyncTrigger()
 
     # -- GRV -------------------------------------------------------------------
 
     async def get_read_version(self, _req: GetReadVersionRequest) -> GetReadVersionReply:
         self._check_alive()
+        # ratekeeper gate: new transactions wait for budget when storage
+        # lags (transactionStarter's rate limiting, :925)
+        while self._grv_budget is not None and self._grv_budget < 1.0:
+            await self._grv_replenished.on_trigger()
+            self._check_alive()
+        if self._grv_budget is not None:
+            self._grv_budget -= 1.0
         # the master's live committed version (reported there before commit
         # acks reach clients) makes reads causally consistent across proxies
         live = await self.process.request(self.master.ep("getLiveCommitted"), None)
         return GetReadVersionReply(version=live.version)
 
+    async def rate_poller(self):
+        """Poll the master's ratekeeper (getRate:85); no ratekeeper (the
+        static test cluster) means no gating. A run of failed polls (dead
+        master) disables gating and wakes parked GRVs — a throttled client
+        must not hang across a recovery."""
+        interval = 0.5
+        misses = 0
+        while True:
+            await delay(interval)
+            try:
+                rate = await self.process.request(self.master.ep("getRate"), None)
+            except Exception:
+                rate = None
+            if rate is None:
+                misses += 1
+                if misses >= 4 and self._grv_budget is not None:
+                    self._grv_budget = None
+                    self._grv_replenished.trigger()
+                continue
+            misses = 0
+            have = self._grv_budget or 0.0
+            self._grv_budget = min(have + rate * interval, 2 * rate * interval)
+            self._grv_replenished.trigger()
+
     # -- key location ----------------------------------------------------------
 
     async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
         self._check_alive()
-        begin, end, team = self.shards.team_for_key(req.key)
-        return GetKeyServersReply(begin=begin, end=end, team=list(team))
+        begin, end, team, tags = self.shards.team_for_key(req.key)
+        return GetKeyServersReply(
+            begin=begin, end=end, team=list(team), tags=list(tags)
+        )
 
     # -- commit ----------------------------------------------------------------
 
@@ -146,7 +218,17 @@ class Proxy:
         while True:
             if not self._batch:
                 self._work = Future()
-                await self._work
+                # an idle proxy still commits an EMPTY batch periodically:
+                # that's how it receives the resolvers' forwarded state
+                # mutations (its shard map would go stale otherwise) and
+                # keeps the version chain moving (the reference's
+                # commit-batch interval bounds / idle commits)
+                which = await wait_for_any(
+                    [self._work, delay(self.knobs.MAX_COMMIT_BATCH_INTERVAL)]
+                )
+                if which == 1 and not self._batch:
+                    self.process.spawn(self.commit_batch([]))
+                    continue
             # batch window: flush on interval or on the size trigger (which
             # may already have fired while we were parked on _work)
             if len(self._batch) < self.knobs.MAX_BATCH_TXNS:
@@ -159,11 +241,15 @@ class Proxy:
 
     async def commit_batch(self, batch):
         replies = [f for _, f in batch]
+        self._local_batch += 1
+        local_n = self._local_batch
         try:
-            await self._commit_batch(batch)
+            await self._commit_batch(batch, local_n)
         except TLogStopped as e:
             # this epoch is over: a recovering master locked our tlogs
             self.failed = True
+            # wake GRVs parked on the rate gate so they see failure
+            self._grv_replenished.trigger()
             for f in replies:
                 if not f.is_ready():
                     f._set_error(BrokenPromise(str(e)))
@@ -176,36 +262,75 @@ class Proxy:
                 if not f.is_ready():
                     f._set_error(e)
             raise
+        finally:
+            # a batch that died before its ordered phases must not wedge
+            # its successors on the gates
+            self._resolving_gate.advance_to(local_n)
+            self._logging_gate.advance_to(local_n)
 
-    async def _commit_batch(self, batch):
+    async def _commit_batch(self, batch, local_n):
         txns = [t for t, _ in batch]
         replies = [f for _, f in batch]
 
-        # phase 1: version assignment
-        vreq = await self.process.request(
-            self.master.ep("getCommitVersion"),
-            GetCommitVersionRequest(requesting_proxy=self.uid),
-        )
-        prev_version, version = vreq.prev_version, vreq.version
+        # phase 1 (ordered): version assignment + send resolve requests.
+        # Ordering phase 1 per proxy makes this proxy's commit versions
+        # monotone in batch order, which phase 3 depends on.
+        await self._resolving_gate.wait_until(local_n - 1)
+        try:
+            vreq = await self.process.request(
+                self.master.ep("getCommitVersion"),
+                GetCommitVersionRequest(requesting_proxy=self.uid),
+            )
+            prev_version, version = vreq.prev_version, vreq.version
+            resolve_futs, resolve_meta = self._send_resolve(
+                prev_version, version, txns
+            )
+        finally:
+            # always release the chain — a failed batch must not wedge the
+            # proxy; successors fail or succeed on their own
+            self._resolving_gate.advance_to(local_n)
 
-        # phase 2: resolution (split per resolver partition)
-        verdicts = await self._resolve(prev_version, version, txns)
+        # phase 2: await resolver verdicts
+        resolutions = await wait_for_all(resolve_futs)
+        verdicts = [Verdict.COMMITTED] * len(txns)
+        for idxs, reply in zip(resolve_meta, resolutions):
+            for i, v in zip(idxs, reply.committed):
+                verdicts[i] = max(verdicts[i], Verdict(v))  # CONFLICT/TOO_OLD win
 
-        # phase 3: versionstamps + tagging
-        to_log: dict[int, list[Mutation]] = {}
-        stamps: list[bytes] = []
-        for idx, (txn, verdict) in enumerate(zip(txns, verdicts)):
-            stamp = make_versionstamp(version, idx)
-            stamps.append(stamp)
-            if verdict != Verdict.COMMITTED:
-                continue
-            for m in substitute_versionstamps(txn.mutations, stamp):
-                if m.type == MutationType.CLEAR_RANGE:
-                    tags = self.shards.tags_for_range(m.param1, m.param2)
-                else:
-                    tags = self.shards.tags_for_key(m.param1)
-                for tag in tags:
-                    to_log.setdefault(tag, []).append(m)
+        # phase 3 (ordered): apply forwarded state mutations to the shard
+        # map in version order, then tag this batch's mutations with the
+        # updated map (commitBatch :414-580)
+        await self._logging_gate.wait_until(local_n - 1)
+        try:
+            plan = self._apply_state_mutations(resolutions, version)
+            to_log: dict[int, list[Mutation]] = {}
+            stamps: list[bytes] = []
+            for idx, (txn, verdict) in enumerate(zip(txns, verdicts)):
+                stamp = make_versionstamp(version, idx)
+                stamps.append(stamp)
+                if verdict != Verdict.COMMITTED:
+                    continue
+                for m in substitute_versionstamps(txn.mutations, stamp):
+                    if m.type == MutationType.CLEAR_RANGE:
+                        tags = self.shards.tags_for_range(m.param1, m.param2)
+                    else:
+                        tags = self.shards.tags_for_key(m.param1)
+                    for tag in tags:
+                        to_log.setdefault(tag, []).append(m)
+                    if is_metadata_mutation(m):
+                        # every metadata mutation also rides the txs tag
+                        # (the recovering master's shard-map delta stream)
+                        to_log.setdefault(TXS_TAG, []).append(m)
+            # privatized copies: shard-assignment changes delivered through
+            # the affected storage servers' own streams
+            for m, private_tags in plan:
+                priv = Mutation(
+                    MutationType.SET_VALUE, PRIVATE_PREFIX + m.param1, m.param2
+                )
+                for tag in private_tags:
+                    to_log.setdefault(tag, []).append(priv)
+        finally:
+            self._logging_gate.advance_to(local_n)
 
         # phase 4: push to the tlog set. Application order is enforced by
         # the tlogs' own prev_version chaining, so pushes of successive
@@ -235,36 +360,49 @@ class Proxy:
             else:
                 reply._set_error(NotCommitted())
 
-    async def _resolve(self, prev_version, version, txns):
+    def _send_resolve(self, prev_version, version, txns):
         """ResolutionRequestBuilder (MasterProxyServer.actor.cpp:233): each
         resolver sees the conflict-range pieces inside its key partition;
         verdicts combine conservatively (committed iff every involved
-        resolver committed)."""
-        resolvers = {}  # iface.uid/addr → (iface, begin, end, idxs, datas)
+        resolver committed). A system-keyspace txn additionally appears in
+        EVERY resolver's request (state_txn_indices) — its metadata
+        mutations ride on resolver 0's copy — so each resolver can echo it
+        to every proxy with its own verdict (:302-305)."""
+        resolvers = []  # [(iface, begin, end, idxs, datas, state_idxs)]
         for r_begin, r_end, iface in self.resolver_map.ranges():
-            resolvers[(iface.address, iface.uid)] = (iface, r_begin, r_end, [], [])
+            resolvers.append((iface, r_begin, r_end, [], [], []))
 
         single = len(resolvers) == 1
-        for _key, (iface, r_begin, r_end, idxs, datas) in resolvers.items():
-            for i, t in enumerate(txns):
+        for i, t in enumerate(txns):
+            is_state = any(is_metadata_mutation(m) for m in t.mutations)
+            for rn, (iface, r_begin, r_end, idxs, datas, state_idxs) in enumerate(
+                resolvers
+            ):
                 if single:
                     rcr, wcr = t.read_conflict_ranges, t.write_conflict_ranges
                 else:
                     rcr = _clip_ranges(t.read_conflict_ranges, r_begin, r_end)
                     wcr = _clip_ranges(t.write_conflict_ranges, r_begin, r_end)
-                if rcr or wcr:
+                if rcr or wcr or is_state:
+                    state_muts = (
+                        [m for m in t.mutations if is_metadata_mutation(m)]
+                        if is_state and rn == 0
+                        else []
+                    )
+                    if is_state:
+                        state_idxs.append(len(datas))
                     idxs.append(i)
                     datas.append(
                         TransactionData(
                             read_snapshot=t.read_snapshot,
                             read_conflict_ranges=rcr,
                             write_conflict_ranges=wcr,
+                            mutations=state_muts,
                         )
                     )
 
-        verdicts = [Verdict.COMMITTED] * len(txns)
         reqs, meta = [], []
-        for _key, (iface, _b, _e, idxs, datas) in resolvers.items():
+        for iface, _b, _e, idxs, datas, state_idxs in resolvers:
             # every resolver sees every version to keep its chain advancing,
             # even with no transactions for it (Resolver.actor.cpp:104-122)
             reqs.append(
@@ -276,16 +414,32 @@ class Proxy:
                         last_receive_version=self.last_resolver_versions,
                         requesting_proxy=f"{self.process.address}#{self.uid}",
                         transactions=datas,
+                        state_txn_indices=state_idxs,
                     ),
                 )
             )
             meta.append(idxs)
         self.last_resolver_versions = version
-        replies = await wait_for_all(reqs)
-        for idxs, reply in zip(meta, replies):
-            for i, v in zip(idxs, reply.committed):
-                verdicts[i] = max(verdicts[i], Verdict(v))  # CONFLICT/TOO_OLD win
-        return verdicts
+        return reqs, meta
+
+    def _apply_state_mutations(self, resolutions, version):
+        """Apply every forwarded state txn (from any proxy) committed at a
+        version ≤ this batch's to our shard map, in version order; a state
+        txn counts committed iff EVERY resolver's echo says so
+        (commitBatch :432-450). Returns the privatization plan for state
+        txns of THIS batch (only the committing proxy pushes them)."""
+        r0 = resolutions[0]
+        plan = []
+        for vi, (v, entries) in enumerate(r0.state_mutations):
+            for ti, (committed, muts) in enumerate(entries):
+                for other in resolutions[1:]:
+                    committed = committed and other.state_mutations[vi][1][ti][0]
+                if not committed:
+                    continue
+                applied = apply_metadata_mutations(self.shards, muts)
+                if v == version:
+                    plan.extend(applied)
+        return plan
 
     # -- wiring ----------------------------------------------------------------
 

@@ -40,6 +40,10 @@ class Resolver:
         self.uid = uid
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
+        # version → [(committed, mutations)] for system-keyspace txns —
+        # forwarded to every proxy so each applies metadata changes in
+        # version order (recentStateTransactions, Resolver.actor.cpp:170)
+        self._state_txns: dict[Version, list] = {}
 
     @property
     def version(self) -> Version:
@@ -71,7 +75,24 @@ class Resolver:
         verdicts = self.cs.detect_batch(
             txns, now=req.version, new_oldest_version=max(0, req.version - window)
         )
-        reply = ResolveBatchReply(committed=[int(v) for v in verdicts])
+
+        if req.state_txn_indices:
+            self._state_txns[req.version] = [
+                (
+                    int(verdicts[i]) == int(Verdict.COMMITTED),
+                    list(req.transactions[i].mutations),
+                )
+                for i in req.state_txn_indices
+            ]
+        # echo state txns for every version this proxy hasn't seen yet
+        state = [
+            (v, entries)
+            for v, entries in sorted(self._state_txns.items())
+            if req.last_receive_version < v <= req.version
+        ]
+        reply = ResolveBatchReply(
+            committed=[int(v) for v in verdicts], state_mutations=state
+        )
 
         self._replies[req.version] = reply
         # retire cached replies once EVERY proxy has moved past them — one
@@ -81,6 +102,8 @@ class Resolver:
             horizon = min(self._proxy_lrv.values())
             for v in [v for v in self._replies if v < horizon]:
                 del self._replies[v]
+            for v in [v for v in self._state_txns if v < horizon]:
+                del self._state_txns[v]
 
         self.gate.advance_to(req.version)
         return reply

@@ -17,8 +17,9 @@ pulling from the new generation's tlogs.
 
 from __future__ import annotations
 
-from ..errors import FutureVersion, TransactionTooOld
+from ..errors import FutureVersion, TransactionTooOld, WrongShardServer
 from ..kv.atomic import apply_atomic
+from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import MutationType
 from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, wait_for_any
@@ -33,6 +34,12 @@ from .interfaces import (
     Version,
 )
 from .log_system import PeekCursor
+from .systemdata import (
+    KEY_SERVERS_PREFIX,
+    PRIVATE_PREFIX,
+    decode_key_servers_key,
+    decode_key_servers_value,
+)
 
 WAIT_FOR_VERSION_TIMEOUT = 1.0  # then future_version (client retries the read)
 
@@ -44,6 +51,7 @@ class StorageServer:
         log_config: AsyncVar,  # AsyncVar[LogSystemConfig]
         knobs: Knobs = None,
         uid: str = "",
+        owned_ranges=None,  # [(begin, end)] | None = owns everything (tests)
     ):
         self.tag = tag
         self.log_config = log_config
@@ -55,6 +63,22 @@ class StorageServer:
         self._followed_epoch = -1
         self.process = None
         self._cursor = None
+        # shard ownership: range → None (not ours) | ("owned", ready_version)
+        # | ("adding", since_version) — the reference's shards map with
+        # AddingShard state (storageserver.actor.cpp:1761 fetchKeys)
+        self.own_all = owned_ranges is None
+        self.owned = KeyRangeMap(default=None)
+        for begin, end in owned_ranges or ():
+            self.owned.insert(begin, end, ("owned", 0))
+        # (begin, end) → [(mutation, version)] buffered during a fetch
+        self._fetch_buffers: dict = {}
+        # (begin, end) → (sources, move_version): enough to re-fetch if a
+        # recovery rolls the spliced snapshot away
+        self._fetch_info: dict = {}
+        # ownership transitions since the durable horizon, for rollback
+        # undo: [(version, begin, end, prior [(b, e, state)])]
+        self._shard_events: list = []
+        self._fetch_generation = 0  # bumped on rollback: in-flight fetches restart
 
     # -- mutation pull loop (update:2321) --------------------------------------
 
@@ -94,10 +118,76 @@ class StorageServer:
                     To=boundary,
                 )
                 self.data.rollback_after(boundary)
+                self._rollback_shard_state(boundary)
                 self.version.set(boundary)
         self._followed_epoch = cfg.epoch
 
+    def _rollback_shard_state(self, boundary: Version) -> None:
+        """Undo shard-ownership effects above the epoch-end boundary:
+        (a) ownership transitions whose metadata version was discarded are
+        reverted to the prior state; (b) a move that *did* survive but
+        whose snapshot was spliced at a rolled-back version is re-fetched
+        (the spliced rows were just deleted by data.rollback_after)."""
+        self._fetch_generation += 1  # in-flight fetches restart their splice
+        for v, begin, end, prior in reversed(
+            [e for e in self._shard_events if e[0] > boundary]
+        ):
+            for b, e, state in reversed(prior):
+                self.owned.insert(b, e, state)
+            self._fetch_buffers.pop((begin, end), None)
+        self._shard_events = [e for e in self._shard_events if e[0] <= boundary]
+        # surviving moves with a rolled-back splice: fetch again
+        for b, e, state in list(self.owned.ranges()):
+            if state is None or state[0] != "owned" or state[1] <= boundary:
+                continue
+            key = next(
+                (
+                    k
+                    for k in self._fetch_info
+                    if k[0] <= b and (k[1] is None or (e is not None and e <= k[1]))
+                ),
+                None,
+            )
+            if key is None:
+                continue
+            sources, move_version = self._fetch_info[key]
+            if move_version > boundary:
+                continue  # the move itself was undone by (a)
+            trace(
+                SevWarn,
+                "FetchKeysRestart",
+                self.process.address if self.process else "ss",
+                Tag=self.tag,
+                Begin=key[0],
+            )
+            self.owned.insert(key[0], key[1], ("adding", move_version))
+            self._fetch_buffers[key] = []
+            self.process.spawn(
+                self._fetch_keys(key[0], key[1], sources, move_version)
+            )
+
     def _apply(self, m, version: Version) -> None:
+        if m.param1.startswith(PRIVATE_PREFIX):
+            self._apply_private(m, version)
+            return
+        # mutations inside a range still being fetched are buffered and
+        # replayed over the snapshot when it lands (fetchKeys's splice)
+        if not self.own_all:
+            if m.type == MutationType.CLEAR_RANGE:
+                seen = set()
+                for b, e, state in self.owned.intersecting(m.param1, m.param2):
+                    if state is not None and state[0] == "adding":
+                        key = self._buffer_key_for(b)
+                        if key is not None and key not in seen:
+                            seen.add(key)
+                            self._fetch_buffers[key].append((m, version))
+            else:
+                state = self.owned[m.param1]
+                if state is not None and state[0] == "adding":
+                    key = self._buffer_key_for(m.param1)
+                    if key is not None:
+                        self._fetch_buffers[key].append((m, version))
+                        return  # point mutation: buffered only
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)
         elif m.type == MutationType.CLEAR_RANGE:
@@ -111,6 +201,136 @@ class StorageServer:
         else:
             raise AssertionError(f"storage can't apply {m!r}")
 
+    def _buffer_key_for(self, k: bytes):
+        for (b, e) in self._fetch_buffers:
+            if b <= k and (e is None or k < e):
+                return (b, e)
+        return None
+
+    # -- shard assignment (privatized metadata; fetchKeys:1761) ----------------
+
+    def _apply_private(self, m, version: Version) -> None:
+        """Privatized metadata mutations: interpreted (shard-assignment
+        changes), never stored as data (ApplyMetadataMutation's \\xff\\xff
+        handling)."""
+        key = m.param1[len(PRIVATE_PREFIX) :]
+        if not key.startswith(KEY_SERVERS_PREFIX):
+            return
+        begin = decode_key_servers_key(key)
+        info = decode_key_servers_value(m.param2)
+        end = info["end"]
+        mine_now = self.tag in info["tags"]
+        state = self.owned[begin]
+        held = state is not None
+        if mine_now and not held:
+            # we're the destination: fetch the data (AddingShard)
+            trace(
+                SevInfo,
+                "FetchKeysBegin",
+                self.process.address,
+                Tag=self.tag,
+                Begin=begin,
+                At=version,
+            )
+            self._shard_events.append(
+                (version, begin, end, list(self.owned.intersecting(begin, end)))
+            )
+            self.owned.insert(begin, end, ("adding", version))
+            self._fetch_buffers[(begin, end)] = []
+            self._fetch_info[(begin, end)] = (tuple(info["old_addrs"]), version)
+            self.process.spawn(
+                self._fetch_keys(begin, end, info["old_addrs"], version)
+            )
+        elif not mine_now and held:
+            # we were removed: drop the data and stop serving
+            trace(
+                SevInfo,
+                "ShardDropped",
+                self.process.address,
+                Tag=self.tag,
+                Begin=begin,
+            )
+            self._shard_events.append(
+                (version, begin, end, list(self.owned.intersecting(begin, end)))
+            )
+            self.owned.insert(begin, end, None)
+            self._fetch_buffers.pop((begin, end), None)
+            self._fetch_info.pop((begin, end), None)
+            self.data.clear_range(begin, end or b"\xff\xff\xff\xff\xff", version)
+
+    async def _fetch_keys(self, begin, end, sources, move_version):
+        """Fetch [begin, end) from the old team at a snapshot, splice the
+        buffered mutation stream on top, become readable
+        (storageserver.actor.cpp:1761)."""
+        generation = self._fetch_generation
+        rows: list = []
+        at_version = max(move_version, self.version.get())
+        src_i = 0
+        lo = begin
+        while True:
+            req = GetKeyValuesRequest(
+                begin=lo,
+                end=end if end is not None else b"\xff\xff\xff\xff\xff",
+                version=at_version,
+                limit=self.knobs.STORAGE_FETCH_KEYS_BATCH,
+            )
+            src = sources[src_i % len(sources)]
+            from ..net.sim import Endpoint
+
+            try:
+                reply = await self.process.request(
+                    Endpoint(src, Tokens.GET_KEY_VALUES), req
+                )
+            except TransactionTooOld:
+                # fell out of the source's MVCC window: restart at a newer
+                # snapshot; buffered mutations ≤ it are covered by it
+                at_version = self.version.get()
+                rows, lo = [], begin
+                continue
+            except Exception:
+                src_i += 1
+                await delay(0.1)
+                continue
+            rows.extend(reply.data)
+            if not reply.more:
+                break
+            lo = reply.data[-1][0] + b"\x00"
+        if generation != self._fetch_generation:
+            return  # a rollback restarted this fetch; the new actor owns it
+        cur = self.owned[begin]
+        if cur is None or cur[0] != "adding":
+            return  # the move was undone (rollback) or superseded
+        # splice: snapshot(at_version) + buffered stream (> at_version)
+        state = dict(rows)
+        buffered = self._fetch_buffers.pop((begin, end), [])
+        for m, v in buffered:
+            if v <= at_version:
+                continue
+            if m.type == MutationType.SET_VALUE:
+                state[m.param1] = m.param2
+            elif m.type == MutationType.CLEAR_RANGE:
+                for k in [k for k in state if m.param1 <= k < m.param2]:
+                    del state[k]
+            elif m.is_atomic():
+                nv = apply_atomic(m.type, state.get(m.param1), m.param2)
+                if nv is None:
+                    state.pop(m.param1, None)
+                else:
+                    state[m.param1] = nv
+        ready_version = self.version.get()
+        for k in sorted(state):
+            self.data.set(k, state[k], ready_version)
+        self.owned.insert(begin, end, ("owned", ready_version))
+        trace(
+            SevInfo,
+            "FetchKeysDone",
+            self.process.address,
+            Tag=self.tag,
+            Begin=begin,
+            Rows=len(state),
+            ReadyVersion=ready_version,
+        )
+
     # -- durability / window advance (updateStorage:2536) ----------------------
 
     async def durability_loop(self):
@@ -123,6 +343,10 @@ class StorageServer:
             if new_durable > self.durable_version:
                 self.durable_version = new_durable
                 self.data.forget_before(new_durable)
+                # shard events below the horizon can no longer roll back
+                self._shard_events = [
+                    e for e in self._shard_events if e[0] > new_durable
+                ]
             if self._cursor is not None:
                 await self._cursor.pop(self.version.get())
 
@@ -139,17 +363,39 @@ class StorageServer:
 
     # -- reads -----------------------------------------------------------------
 
+    def _check_read(self, begin: bytes, end, version: Version) -> None:
+        """Serve only shards we fully own with data complete at `version`
+        (else wrong_shard_server — the client re-locates and retries)."""
+        if self.own_all:
+            return
+        for _b, _e, state in self.owned.intersecting(begin, end):
+            if state is None or state[0] != "owned" or version < state[1]:
+                raise WrongShardServer()
+
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
         await self._wait_for_version(req.version)
+        self._check_read(req.key, req.key + b"\x00", req.version)
         return GetValueReply(value=self.data.get(req.key, req.version))
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         await self._wait_for_version(req.version)
+        self._check_read(req.begin, req.end, req.version)
         data = self.data.range(
             req.begin, req.end, req.version, limit=req.limit + 1, reverse=req.reverse
         )
         more = len(data) > req.limit
         return GetKeyValuesReply(data=data[: req.limit], more=more)
+
+    async def get_shard_state(self, req) -> bool:
+        """Is [begin, end) fully owned and readable? (the mover's readiness
+        poll before finishMoveKeys — getShardStateQ in the reference)."""
+        begin, end = req
+        if self.own_all:
+            return True
+        for _b, _e, state in self.owned.intersecting(begin, end):
+            if state is None or state[0] != "owned":
+                return False
+        return True
 
     # -- wiring ----------------------------------------------------------------
 
@@ -166,6 +412,7 @@ class StorageServer:
         process.register(Tokens.GET_KEY_VALUES, self.get_key_values)
         process.register(f"storage.version#{self.uid}", self._get_version)
         process.register(f"storage.ping#{self.uid}", self._ping)
+        process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
         trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
 
     def register(self, process) -> None:

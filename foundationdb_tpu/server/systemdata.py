@@ -1,0 +1,103 @@
+"""The \\xff system keyspace: schema, encoders, metadata-mutation helpers.
+
+The analog of fdbclient/SystemData.cpp (keyServersKeys/serverListKeys at
+:25-33) plus the pieces of fdbserver/ApplyMetadataMutation.h that interpret
+keyServers changes:
+
+- ``\\xff/keyServers/<begin>`` → the shard starting at <begin>: its team
+  (storage addresses + tags) and, during a move, the old team that still
+  holds the data (the source for the destination's fetchKeys).
+- ``\\xff\\xff...`` — the *private* prefix: a copy of a metadata mutation
+  delivered through a storage server's own tag stream so it learns about
+  shard assignment changes in version order with its data
+  (ApplyMetadataMutation's privatized mutations). Private rows are
+  interpreted, never stored.
+- ``TXS_TAG`` — the transaction-state tag: every metadata mutation is also
+  pushed to every tlog under this tag, so a recovering master can rebuild
+  the live shard map from the coordinated-state snapshot plus the tag's
+  deltas (the reference's txnStateStore-in-the-log,
+  LogSystemDiskQueueAdapter + readTransactionSystemState).
+"""
+
+from __future__ import annotations
+
+import json
+
+SYSTEM_PREFIX = b"\xff"
+PRIVATE_PREFIX = b"\xff\xff"
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+SERVER_LIST_PREFIX = b"\xff/serverList/"
+CONF_PREFIX = b"\xff/conf/"
+
+TXS_TAG = -1  # the txnStateStore tag, on every tlog
+
+
+def key_servers_key(begin: bytes) -> bytes:
+    return KEY_SERVERS_PREFIX + begin
+
+
+def decode_key_servers_key(key: bytes) -> bytes:
+    assert key.startswith(KEY_SERVERS_PREFIX)
+    return key[len(KEY_SERVERS_PREFIX) :]
+
+
+def key_servers_value(addrs, tags, old_addrs=(), old_tags=(), end=None) -> bytes:
+    """Team for the shard; during a move old_* is the source team still
+    holding the data (the reference encodes src/dest sets the same way).
+    ``end`` makes the range explicit so a storage server can interpret its
+    privatized copy without knowing the whole boundary set."""
+    return json.dumps(
+        {
+            "addrs": list(addrs),
+            "tags": list(tags),
+            "old_addrs": list(old_addrs),
+            "old_tags": list(old_tags),
+            "end": end.hex() if end is not None else "inf",
+        }
+    ).encode()
+
+
+def decode_key_servers_value(value: bytes) -> dict:
+    d = json.loads(value.decode())
+    end = d.get("end", "inf")
+    return {
+        "addrs": tuple(d["addrs"]),
+        "tags": tuple(d["tags"]),
+        "old_addrs": tuple(d.get("old_addrs", ())),
+        "old_tags": tuple(d.get("old_tags", ())),
+        "end": None if end == "inf" else bytes.fromhex(end),
+    }
+
+
+def is_metadata_mutation(m) -> bool:
+    """Does this mutation touch the system keyspace? (the proxy's
+    isMetadataMutation test in ResolutionRequestBuilder)."""
+    return m.param1.startswith(SYSTEM_PREFIX) and not m.param1.startswith(
+        PRIVATE_PREFIX
+    )
+
+
+def apply_metadata_mutations(shard_map, mutations):
+    """Apply committed metadata mutations to a proxy's keyInfo shard map
+    (ApplyMetadataMutation.h). Returns the tagging plan: for each
+    keyServers mutation, (mutation, private_tags) where private_tags are
+    the storage tags (old ∪ new teams) that must see a privatized copy in
+    their streams."""
+    from ..kv.mutations import MutationType
+
+    plan = []
+    for m in mutations:
+        if m.type != MutationType.SET_VALUE or not m.param1.startswith(
+            KEY_SERVERS_PREFIX
+        ):
+            continue
+        begin = decode_key_servers_key(m.param1)
+        info = decode_key_servers_value(m.param2)
+        end = info["end"]
+        old_tags = set()
+        for _b, _e, v in shard_map.map.intersecting(begin, end):
+            if v is not None:
+                old_tags.update(v[1])
+        shard_map.set_shard(begin, end, info["addrs"], info["tags"])
+        plan.append((m, tuple(old_tags | set(info["tags"]))))
+    return plan

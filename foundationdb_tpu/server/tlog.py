@@ -23,6 +23,7 @@ from __future__ import annotations
 import bisect
 from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
+from .systemdata import TXS_TAG
 from .interfaces import (
     TLogCommitRequest,
     TLogLockReply,
@@ -89,7 +90,7 @@ class TLog:
             msgs = {
                 t: ms
                 for t, ms in req.messages.items()
-                if ms and (self.tags is None or t in self.tags)
+                if ms and (self.tags is None or t in self.tags or t == TXS_TAG)
             }
             if msgs:
                 self._log.append((req.version, msgs))

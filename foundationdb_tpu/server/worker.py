@@ -258,8 +258,9 @@ class Worker:
         h.epoch, h.obj = epoch, pr
         pr.register_instance(self.process)
         self._spawn(h, pr.batcher_loop())
+        self._spawn(h, pr.rate_poller())
 
-    def _make_storage(self, h, tag=0):
+    def _make_storage(self, h, tag=0, ranges=None):
         from .storage import StorageServer
 
         # storage keeps well-known data tokens: strictly one per process
@@ -269,7 +270,11 @@ class Worker:
             del self.roles[h.uid]
             raise RuntimeError(f"{self.process.address} already hosts storage")
         ss = StorageServer(
-            tag=tag, log_config=self.log_config, knobs=self.knobs, uid=h.uid
+            tag=tag,
+            log_config=self.log_config,
+            knobs=self.knobs,
+            uid=h.uid,
+            owned_ranges=ranges,
         )
         h.obj = ss
         ss.register_endpoints(self.process)

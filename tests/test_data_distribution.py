@@ -1,0 +1,120 @@
+"""DataDistribution: automatic re-replication after storage failure, and
+Ratekeeper admission control.
+
+The analog of the reference's RemoveServersSafely/ConsistencyCheck spirit:
+kill a storage server; DD (in the master) must rebuild the affected teams
+on healthy servers via MoveKeys; all data stays readable at full
+replication.
+"""
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.server.interfaces import GetKeyServersRequest, Tokens
+
+
+def make(seed=0, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = DynamicCluster(sim, ClusterConfig(**cfg))
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    return sim, cluster, db
+
+
+def run(sim, coro, limit=600.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+async def put(db, key, value):
+    async def body(tr):
+        tr.set(key, value)
+
+    await db.run(body)
+
+
+async def get(db, key):
+    async def body(tr):
+        return await tr.get(key)
+
+    return await db.run(body)
+
+
+async def walk_shards(db):
+    out, key = [], b""
+    while True:
+        r = await db._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+        )
+        out.append((r.begin, r.end, tuple(r.tags)))
+        if r.end is None:
+            return out
+        key = r.end
+
+
+def test_dd_rereplicates_after_storage_death():
+    sim, cluster, db = make(
+        seed=31,
+        n_proxies=1,
+        n_resolvers=1,
+        n_tlogs=2,
+        n_storage=4,
+        replication=2,
+        tlog_replication=2,
+    )
+
+    async def body():
+        for i in range(40):
+            await put(db, b"%02x-key" % (i * 6), b"v%d" % i)  # spread shards
+
+        # kill the storage server with tag 3 (its worker, no reboot)
+        victim = None
+        for addr, p in sim.processes.items():
+            w = getattr(p, "worker", None)
+            if w and p.alive:
+                for h in w.roles.values():
+                    if h.kind == "storage" and h.obj.tag == 3:
+                        victim = addr
+        assert victim
+        sim.kill_process(victim)
+
+        # DD must notice and rebuild every team containing tag 3
+        deadline = 60.0
+        start = sim.loop.now()
+        while True:
+            await delay(2.0)
+            shards = await walk_shards(db)
+            if all(3 not in tags and len(tags) == 2 for _b, _e, tags in shards):
+                break
+            assert sim.loop.now() - start < deadline, shards
+
+        # all data still present, served at full replication
+        db.invalidate_cache(b"\x00")
+        db._locations = type(db._locations)(default=None)
+        for i in range(40):
+            assert await get(db, b"%02x-key" % (i * 6)) == b"v%d" % i, i
+
+    run(sim, body())
+
+
+def test_ratekeeper_reports_rate():
+    sim, cluster, db = make(
+        seed=32, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1
+    )
+
+    async def body():
+        await put(db, b"a", b"1")
+        # find the live proxy and check its rate gate engaged (a getRate
+        # reply arrived and budget is finite)
+        await delay(2.0)
+        budgets = [
+            h.obj._grv_budget
+            for p in sim.processes.values()
+            if getattr(p, "worker", None)
+            for h in p.worker.roles.values()
+            if h.kind == "proxy" and not h.obj.failed
+        ]
+        assert budgets and all(b is not None for b in budgets), budgets
+        assert await get(db, b"a") == b"1"
+
+    run(sim, body())
