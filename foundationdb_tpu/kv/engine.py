@@ -1,0 +1,122 @@
+"""IKeyValueStore: local durable storage engines.
+
+The analog of fdbserver/IKeyValueStore.h (:27-77): the storage server's
+and txn-state's local engine seam. Engines here:
+
+- ``KeyValueStoreMemory`` — the reference's memory engine
+  (KeyValueStoreMemory.actor.cpp): all data in an ordered in-memory map;
+  durability from an operation log in a DiskQueue, periodically compacted
+  by writing a full snapshot entry and popping everything before it
+  (:337 op-log, :580 snapshotting).
+- the native B-tree engine (foundationdb_tpu/native) is its
+  disk-resident sibling for real deployments — same interface.
+
+Writes buffer in memory; ``commit()`` makes everything before it durable.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from ..runtime.serialize import BinaryReader, BinaryWriter
+from .diskqueue import DiskQueue
+
+_OP_SET = 0
+_OP_CLEAR = 1
+_SNAPSHOT = 2
+
+
+class KeyValueStoreMemory:
+    SNAPSHOT_AFTER_BYTES = 1 << 20  # op-log size that triggers a snapshot
+
+    def __init__(self, disk, name: str):
+        self.dq = DiskQueue(disk, name)
+        self._keys: list[bytes] = []  # sorted
+        self._map: dict[bytes, bytes] = {}
+        self._ops = BinaryWriter()
+        self._ops_count = 0
+
+    # -- recovery --------------------------------------------------------------
+
+    async def recover(self) -> None:
+        entries = await self.dq.recover()
+        for _off, payload in entries:
+            r = BinaryReader(payload)
+            kind = r.u8()
+            if kind == _SNAPSHOT:
+                self._map = {}
+                n = r.u32()
+                for _ in range(n):
+                    k = r.bytes_()
+                    self._map[k] = r.bytes_()
+            else:
+                self._apply_ops(r, kind)
+                while r.remaining():
+                    self._apply_ops(r, r.u8())
+        self._keys = sorted(self._map)
+
+    def _apply_ops(self, r: BinaryReader, kind: int) -> None:
+        if kind == _OP_SET:
+            self._map[r.bytes_()] = r.bytes_()
+        elif kind == _OP_CLEAR:
+            b, e = r.bytes_(), r.bytes_()
+            for k in [k for k in self._map if b <= k < e]:
+                del self._map[k]
+        else:
+            raise AssertionError(f"bad op {kind}")
+
+    # -- writes ----------------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if key not in self._map:
+            bisect.insort(self._keys, key)
+        self._map[key] = value
+        self._ops.u8(_OP_SET).bytes_(key).bytes_(value)
+        self._ops_count += 1
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for k in self._keys[lo:hi]:
+            del self._map[k]
+        del self._keys[lo:hi]
+        self._ops.u8(_OP_CLEAR).bytes_(begin).bytes_(end)
+        self._ops_count += 1
+
+    async def commit(self) -> None:
+        if self._ops_count:
+            self.dq.push(self._ops.data())
+            self._ops = BinaryWriter()
+            self._ops_count = 0
+        await self.dq.commit()
+        if self.dq.bytes_used > self.SNAPSHOT_AFTER_BYTES:
+            await self._snapshot()
+
+    async def _snapshot(self) -> None:
+        w = BinaryWriter()
+        w.u8(_SNAPSHOT).u32(len(self._map))
+        for k in self._keys:
+            w.bytes_(k).bytes_(self._map[k])
+        offset = self.dq.push(w.data())
+        await self.dq.commit()
+        self.dq.pop(offset)
+        await self.dq.commit()
+        await self.dq.compact()
+
+    # -- reads -----------------------------------------------------------------
+
+    def read_value(self, key: bytes):
+        return self._map.get(key)
+
+    def read_range(self, begin: bytes, end: bytes, limit: int = 1 << 30):
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        out = []
+        for k in self._keys[lo:hi]:
+            out.append((k, self._map[k]))
+            if len(out) >= limit:
+                break
+        return out
+
+    def __len__(self) -> int:
+        return len(self._map)

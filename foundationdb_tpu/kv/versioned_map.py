@@ -80,6 +80,35 @@ class VersionedMap:
         assert version >= self.oldest_version, "read below MVCC window"
         return self._at(key, version)
 
+    def get_with_presence(self, key: bytes, version: int):
+        """(known, value): known=False means the window has no entry — the
+        caller falls through to the durable engine (the storage server's
+        memory-over-disk merge, storageserver readRange:916)."""
+        assert version >= self.oldest_version, "read below MVCC window"
+        h = self._hist.get(key)
+        if not h:
+            return False, None
+        i = _find_le(h, version)
+        if i < 0:
+            return False, None  # all entries newer than `version`
+        return True, h[i][1]
+
+    def entries_with_tombstones(
+        self, begin: bytes, end: bytes, version: int
+    ) -> list[tuple[bytes, Optional[bytes]]]:
+        """All window-known (key, value|None-tombstone) in [begin, end) at
+        `version` — for merging over the engine's rows."""
+        assert version >= self.oldest_version
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        out = []
+        for k in self._keys[lo:hi]:
+            h = self._hist.get(k)
+            i = _find_le(h, version)
+            if i >= 0:
+                out.append((k, h[i][1]))
+        return out
+
     def range(
         self,
         begin: bytes,
@@ -128,10 +157,14 @@ class VersionedMap:
 
     # -- compaction -----------------------------------------------------------
 
-    def forget_before(self, version: int) -> None:
+    def forget_before(self, version: int, drop_known: bool = False) -> None:
         """Advance oldest_version, dropping superseded history (the analog of
         the storage server making versions durable and trimming the treap,
-        storageserver.actor.cpp:2536)."""
+        storageserver.actor.cpp:2536).
+
+        drop_known=True additionally drops entries ≤ version entirely —
+        correct only when a durable engine holds the state at `version`
+        and reads fall through to it (get_with_presence)."""
         if version <= self.oldest_version:
             return
         version = min(version, self.latest_version)
@@ -139,6 +172,12 @@ class VersionedMap:
         for key, h in self._hist.items():
             # keep the newest entry at-or-below `version` plus everything after
             i = _find_le(h, version)
+            if drop_known:
+                if i >= 0:
+                    del h[: i + 1]
+                if not h:
+                    dead.append(key)
+                continue
             if i > 0:
                 del h[:i]
             if len(h) == 1 and h[0][1] is None and h[0][0] <= version:

@@ -1,0 +1,166 @@
+"""Async file I/O: the durability substrate, virtualized for simulation.
+
+The analog of fdbrpc/IAsyncFile.h with its two personalities:
+
+- ``SimFile`` — the simulator's file (AsyncFileNonDurable.actor.h): writes
+  land in an unsynced overlay with modeled latency; ``sync()`` promotes
+  them to durable content; a process kill DROPS (or partially applies —
+  the corruption model of :460-505) everything unsynced. Files live in
+  the machine's ``SimDisk`` and survive reboot, which is exactly what
+  makes restart tests meaningful.
+- ``RealFile`` — plain OS files (AsyncFileEIO's job); used outside the
+  simulator (benchmarks, the native engine's siblings).
+
+Only whole-value page semantics are needed by the engines here (DiskQueue
+pages, snapshot blobs), so the API is a minimal subset: read / write /
+truncate / sync / size.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..runtime.futures import delay
+
+
+class SimDisk:
+    """All files of one simulated machine; survives process reboot."""
+
+    def __init__(self, sim, machine: str):
+        self.sim = sim
+        self.machine = machine
+        self.files: dict[str, "SimFile"] = {}
+
+    def open(self, path: str) -> "SimFile":
+        f = self.files.get(path)
+        if f is None:
+            f = self.files[path] = SimFile(self.sim, path)
+        return f
+
+    def exists(self, path: str) -> bool:
+        return path in self.files
+
+    def list(self) -> list[str]:
+        return sorted(self.files)
+
+    def remove(self, path: str) -> None:
+        self.files.pop(path, None)
+
+    def on_kill(self) -> None:
+        """Machine kill: unsynced writes are lost — and, buggify-style,
+        a random prefix of them may have reached the platter
+        (AsyncFileNonDurable:460-505's KILLED mode)."""
+        rng = self.sim.loop.random
+        for f in self.files.values():
+            f.lose_unsynced(rng)
+
+
+class SimFile:
+    SYNC_TIME = 0.0005  # modeled fsync
+    WRITE_TIME = 0.00005
+
+    def __init__(self, sim, path: str):
+        self.sim = sim
+        self.path = path
+        self._durable = bytearray()
+        # unsynced writes: [(offset, bytes)] in application order
+        self._pending: list[tuple[int, bytes]] = []
+        self._pending_truncate = None
+
+    # -- IAsyncFile ------------------------------------------------------------
+
+    async def write(self, offset: int, data: bytes) -> None:
+        await delay(self.WRITE_TIME)
+        self._pending.append((offset, bytes(data)))
+
+    async def read(self, offset: int, length: int) -> bytes:
+        await delay(self.WRITE_TIME)
+        img = self._image()
+        return bytes(img[offset : offset + length])
+
+    async def sync(self) -> None:
+        await delay(self.SYNC_TIME)
+        self._durable = self._image()
+        self._pending = []
+        self._pending_truncate = None
+
+    async def truncate(self, size: int) -> None:
+        await delay(self.WRITE_TIME)
+        self._pending_truncate = size
+        self._pending = [(o, d) for o, d in self._pending if o < size]
+
+    def size(self) -> int:
+        return len(self._image())
+
+    # -- sim internals ---------------------------------------------------------
+
+    def _image(self) -> bytearray:
+        img = bytearray(self._durable)
+        if self._pending_truncate is not None:
+            del img[self._pending_truncate :]
+        for offset, data in self._pending:
+            if len(img) < offset:
+                img.extend(b"\x00" * (offset - len(img)))
+            img[offset : offset + len(data)] = data
+        return img
+
+    def lose_unsynced(self, rng) -> None:
+        """Kill semantics: each unsynced write independently may or may
+        not have hit the disk (the nondurable file's page-wise coinflip)."""
+        survivors = [w for w in self._pending if rng.coinflip(0.5)]
+        keep_truncate = (
+            self._pending_truncate is not None and rng.coinflip(0.5)
+        )
+        self._pending = survivors
+        if self._pending_truncate is not None and not keep_truncate:
+            self._pending_truncate = None
+        self._durable = self._image()
+        self._pending = []
+        self._pending_truncate = None
+
+
+class RealDisk:
+    """OS directory as a disk (for benches and the native engine path)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def open(self, path: str) -> "RealFile":
+        return RealFile(os.path.join(self.root, path))
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(os.path.join(self.root, path))
+
+    def list(self) -> list[str]:
+        return sorted(os.listdir(self.root))
+
+    def remove(self, path: str) -> None:
+        p = os.path.join(self.root, path)
+        if os.path.exists(p):
+            os.unlink(p)
+
+
+class RealFile:
+    def __init__(self, path: str):
+        self.path = path
+        flags = os.O_RDWR | os.O_CREAT
+        self._fd = os.open(path, flags, 0o644)
+
+    async def write(self, offset: int, data: bytes) -> None:
+        os.pwrite(self._fd, data, offset)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        return os.pread(self._fd, length, offset)
+
+    async def sync(self) -> None:
+        os.fsync(self._fd)
+
+    async def truncate(self, size: int) -> None:
+        os.ftruncate(self._fd, size)
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    def close(self) -> None:
+        os.close(self._fd)

@@ -75,8 +75,18 @@ class Sim:
         self.loop = EventLoop(seed)
         self.knobs = knobs or Knobs()
         self.processes: dict[str, SimProcess] = {}
+        self.disks: dict[str, Any] = {}  # machine → SimDisk (survives reboot)
         self._clogged_until: dict[tuple[str, str], float] = {}
         self._partitioned: set[tuple[str, str]] = set()
+
+    def disk(self, machine: str):
+        """The machine's persistent SimDisk (files survive kill/reboot)."""
+        d = self.disks.get(machine)
+        if d is None:
+            from .files import SimDisk
+
+            d = self.disks[machine] = SimDisk(self, machine)
+        return d
 
     # -- world construction ---------------------------------------------------
 
@@ -183,6 +193,9 @@ class Sim:
         p.alive = False
         p.actors.cancel_all()
         p.endpoints.clear()
+        disk = self.disks.get(p.machine)
+        if disk is not None:
+            disk.on_kill()  # unsynced writes lost (AsyncFileNonDurable)
         if reboot_in is not None and p.boot is not None:
             self.loop.call_at(self.loop.now() + reboot_in, lambda: self.reboot(address))
 

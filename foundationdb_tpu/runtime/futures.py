@@ -113,16 +113,21 @@ class Task:
         self.priority = priority
         self._cancelled = False
         self._waiting_on: Optional[Future] = None
+        # home loop: every (re)scheduling of this task goes here, NOT to
+        # whatever loop is current at wake time — when an old simulation's
+        # coroutines are garbage-collected while a new simulation runs,
+        # their finalizers must not leak callbacks into the new world
+        self.loop = current_loop()
 
     def start(self) -> Future:
-        current_loop().call_soon(lambda: self._step(None, None), self.priority)
+        self.loop.call_soon(lambda: self._step(None, None), self.priority)
         return self.future
 
     def cancel(self) -> None:
         if self.future.is_ready() or self._cancelled:
             return
         self._cancelled = True
-        current_loop().call_soon(
+        self.loop.call_soon(
             lambda: self._step(None, Cancelled()), TaskPriority.MAX
         )
 
@@ -154,7 +159,7 @@ class Task:
         if self._cancelled:
             # keep re-throwing at every await until the body exits, so an
             # actor that catches Cancelled and awaits again can't hang forever
-            current_loop().call_soon(
+            self.loop.call_soon(
                 lambda: self._step(None, Cancelled()), TaskPriority.MAX
             )
             return
@@ -171,11 +176,11 @@ class Task:
             ):
                 return
             if f._error is not None:
-                current_loop().call_soon(
+                task.loop.call_soon(
                     lambda: task._step(None, f._error), task.priority
                 )
             else:
-                current_loop().call_soon(
+                task.loop.call_soon(
                     lambda: task._step(f._value, None), task.priority
                 )
 

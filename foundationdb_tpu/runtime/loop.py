@@ -57,8 +57,13 @@ class EventLoop:
     def call_at(
         self, when: float, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT
     ) -> None:
-        self._seq += 1
-        heapq.heappush(self._queue, (max(when, self._time), -priority, self._seq, fn))
+        # reentrancy-safe: a GC run triggered by the allocations below can
+        # finalize coroutines whose finally-blocks schedule more callbacks
+        # (re-entering this method); the seq must be latched in a local or
+        # two entries can share one and the heap falls over comparing the
+        # callables
+        seq = self._seq = self._seq + 1
+        heapq.heappush(self._queue, (max(when, self._time), -priority, seq, fn))
 
     def call_soon(
         self, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT

@@ -152,15 +152,14 @@ class ClusterController:
         return self.db_info.get()
 
     async def _broadcast_loop(self):
-        """Push ServerDBInfo to every live worker on change (and
-        periodically, for workers that registered after the last change)."""
-        sent: dict[str, int] = {}
+        """Push ServerDBInfo to every live worker on change and on every
+        heartbeat — a rebooted worker re-registers under the same address
+        and must get the current info again (workers dedupe by id), so no
+        per-address sent-cache here."""
         while True:
             info = self.db_info.get()
             if info is not None:
                 for d in self._alive_workers():
-                    if sent.get(d.address) == info.id:
-                        continue
                     try:
                         await timeout(
                             self.process.request(
@@ -169,7 +168,6 @@ class ClusterController:
                             ),
                             1.0,
                         )
-                        sent[d.address] = info.id
                     except Exception:
                         pass
             change = self.db_info.on_change()

@@ -45,6 +45,7 @@ class Tokens:
     GEN_READ = "coord.genRead"
     GEN_WRITE = "coord.genWrite"
     CANDIDACY = "coord.candidacy"
+    LEADER_HEARTBEAT = "coord.leaderHeartbeat"
     GET_LEADER = "coord.getLeader"
 
 
@@ -132,8 +133,15 @@ class _Register:
 
 
 @dataclass
+class LeaderHeartbeatRequest:
+    key: str = "db"
+    leader: LeaderInfo = None
+
+
+@dataclass
 class _LeaderState:
     candidates: dict = field(default_factory=dict)  # address → (info, lease_deadline)
+    leaders: dict = field(default_factory=dict)  # address → (info, lease_deadline)
     nominee: Optional[LeaderInfo] = None
     change: AsyncVar = field(default_factory=lambda: AsyncVar(0))
 
@@ -178,32 +186,33 @@ class CoordinatorServer:
         return self.leaders.setdefault(key, _LeaderState())
 
     def _recompute(self, key: str) -> None:
+        """The reference's nomination rule (leaderRegister,
+        Coordination.actor.cpp:252-275): prefer a live heartbeating LEADER;
+        among mere candidates pick the best by the total (priority,
+        change_id) order — total order is what makes split votes across
+        coordinators converge — and displace a live leader only for a
+        candidate of strictly higher priority (leaderChangeRequired)."""
         st = self._leader(key)
         t = now()
         st.candidates = {
             a: (info, dl) for a, (info, dl) in st.candidates.items() if dl > t
         }
-        # a live nominee is sticky: it only loses the nomination to a
-        # *strictly better priority* candidate or by lease expiry — without
-        # this, every new candidate with a luckier change_id would steal
-        # the nomination and the cluster would elect controllers in a loop
-        # (the reference's leaderRegister keeps currentNominee the same way)
-        cur = st.nominee
-        if cur is not None:
-            live = st.candidates.get(cur.address)
-            if (
-                live is not None
-                and live[0].change_id == cur.change_id
-                and all(
-                    info.priority <= cur.priority
-                    for info, _dl in st.candidates.values()
-                )
-            ):
-                return
-        best = None
+        st.leaders = {
+            a: (info, dl) for a, (info, dl) in st.leaders.items() if dl > t
+        }
+        best_leader = None
+        for info, _dl in st.leaders.values():
+            if best_leader is None or info.order() > best_leader.order():
+                best_leader = info
+        best_cand = None
         for info, _dl in st.candidates.values():
-            if best is None or info.order() > best.order():
-                best = info
+            if best_cand is None or info.order() > best_cand.order():
+                best_cand = info
+        best = best_leader
+        if best is None or (
+            best_cand is not None and best_cand.priority > best.priority
+        ):
+            best = best_cand
         if (best and best.change_id) != (st.nominee and st.nominee.change_id):
             st.nominee = best
             st.change.set(st.change.get() + 1)
@@ -227,6 +236,19 @@ class CoordinatorServer:
             await st.change.on_change()
         return LeaderReply(nominee=st.nominee)
 
+    async def leader_heartbeat(self, req: LeaderHeartbeatRequest) -> bool:
+        """An elected leader keeps its seat alive; True iff it is still
+        this coordinator's nominee (leaderHeartbeat:228)."""
+        st = self._leader(req.key)
+        st.leaders[req.leader.address] = (req.leader, now() + CANDIDATE_LEASE)
+        # the leader stops campaigning; drop its candidate entry
+        st.candidates.pop(req.leader.address, None)
+        self._recompute(req.key)
+        return (
+            st.nominee is not None
+            and st.nominee.change_id == req.leader.change_id
+        )
+
     async def get_leader(self, req: GetLeaderRequest) -> LeaderReply:
         st = self._leader(req.key)
         self._recompute(req.key)
@@ -248,6 +270,7 @@ class CoordinatorServer:
         process.register(Tokens.GEN_READ, self.gen_read)
         process.register(Tokens.GEN_WRITE, self.gen_write)
         process.register(Tokens.CANDIDACY, self.candidacy)
+        process.register(Tokens.LEADER_HEARTBEAT, self.leader_heartbeat)
         process.register(Tokens.GET_LEADER, self.get_leader)
         process.spawn(self._tick())
 
@@ -402,28 +425,26 @@ class Leadership:
         self._actor = self.process.spawn(self._hold())
 
     async def _hold(self):
+        """Keep the seat with leader heartbeats (no longer a candidate —
+        the heartbeat set is preferred by the registers, which is what
+        stops later candidates with luckier change_ids from stealing)."""
         misses = 0
         while True:
             await delay(POLL_DELAY)
             held = 0
             futs = [
                 self.process.request(
-                    Endpoint(c, Tokens.CANDIDACY),
-                    CandidacyRequest(
-                        key=self.key, candidate=self.info, prev_change_id=-1
-                    ),
+                    Endpoint(c, Tokens.LEADER_HEARTBEAT),
+                    LeaderHeartbeatRequest(key=self.key, leader=self.info),
                 )
                 for c in self.coordinators
             ]
             for f in futs:
                 try:
-                    reply = await f
+                    still_nominee = await f
                 except Exception:
                     continue
-                if (
-                    reply.nominee is not None
-                    and reply.nominee.change_id == self.info.change_id
-                ):
+                if still_nominee:
                     held += 1
             if held >= _majority(len(self.coordinators)):
                 misses = 0

@@ -38,6 +38,10 @@ class DataDistributor:
         self.knobs = knobs
         self.replication = replication
         self.alive: dict[int, bool] = {s.tag: True for s in storage}
+        # (shard begin, tag) → consecutive rounds a live member reported
+        # the shard unreadable (e.g. it rebooted and lost an in-flight
+        # fetch whose sources are gone) — treated like a dead member
+        self._unready: dict = {}
 
     async def run(self):
         monitor = self.process.spawn(self._failure_monitor())
@@ -81,6 +85,29 @@ class DataDistributor:
                     )
                 self.alive[s.tag] = now_alive
 
+    async def _check_member_readiness(self, shards, by_tag):
+        from ..net.sim import Endpoint
+
+        for begin, end, tags in shards:
+            for t in tags:
+                if not self.alive.get(t, False) or t not in by_tag:
+                    continue
+                key = (begin, t)
+                try:
+                    ready = await timeout(
+                        self.process.request(
+                            Endpoint(by_tag[t].address, Tokens.GET_SHARD_STATE),
+                            (begin, end),
+                        ),
+                        1.0,
+                    )
+                except Exception:
+                    ready = None
+                if ready:
+                    self._unready.pop(key, None)
+                else:
+                    self._unready[key] = self._unready.get(key, 0) + 1
+
     async def _walk_shards(self):
         """[(begin, end, tags)] from the proxies' live keyInfo."""
         out = []
@@ -102,11 +129,17 @@ class DataDistributor:
                 if t in load:
                     load[t] += 1
         by_tag = {s.tag: s for s in self.storage}
+        await self._check_member_readiness(shards, by_tag)
         for begin, end, tags in shards:
-            dead = [t for t in tags if not self.alive.get(t, False)]
+            dead = [
+                t
+                for t in tags
+                if not self.alive.get(t, False)
+                or self._unready.get((begin, t), 0) >= 4
+            ]
             if not dead:
                 continue
-            healthy = [t for t in tags if self.alive.get(t, False)]
+            healthy = [t for t in tags if t not in dead]
             candidates = sorted(
                 (
                     t
@@ -167,7 +200,7 @@ class Ratekeeper:
                 except Exception:
                     continue
                 if r is not None:
-                    version, _epoch = r
+                    version, _durable, _epoch = r
                     lags.append(self.master.last_assigned - version)
             if not lags:
                 continue

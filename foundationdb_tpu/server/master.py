@@ -514,16 +514,17 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
             await wait_for_any([settled(f), deadline])
             if f.is_ready() and not f.is_error():
                 replies.append(f.get())
-        # a server counts as caught up only once it follows THIS epoch:
-        # before that its version may contain a discarded pre-recovery
-        # tail it hasn't rolled back yet. Unreachable servers don't pin
-        # the old generation — a dead one never returns with its memory,
-        # and DD re-replicates its shards (a long partition risks leaving
+        # a server counts as caught up only once it follows THIS epoch AND
+        # has PERSISTED past the recovery version: before that its version
+        # may contain a discarded pre-recovery tail it hasn't rolled back
+        # yet, and a reboot would still need the old generation's data.
+        # Unreachable servers don't pin the old generation — a dead one's
+        # shards get re-replicated by DD (a long partition risks leaving
         # such a server permanently behind; the reference's per-server
         # popping is future work).
         if replies and all(
-            epoch == core.recovery_count and version > core.recovery_version
-            for version, epoch in replies
+            epoch == core.recovery_count and durable > core.recovery_version
+            for _version, durable, epoch in replies
         ):
             break
     new_core = DBCoreState(

@@ -19,10 +19,11 @@ from __future__ import annotations
 
 from ..errors import FutureVersion, TransactionTooOld, WrongShardServer
 from ..kv.atomic import apply_atomic
+from ..kv.engine import KeyValueStoreMemory
 from ..kv.keyrange_map import KeyRangeMap
-from ..kv.mutations import MutationType
+from ..kv.mutations import Mutation, MutationType
 from ..kv.versioned_map import VersionedMap
-from ..runtime.futures import AsyncVar, delay, wait_for_any
+from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
@@ -52,6 +53,7 @@ class StorageServer:
         knobs: Knobs = None,
         uid: str = "",
         owned_ranges=None,  # [(begin, end)] | None = owns everything (tests)
+        disk=None,  # SimDisk/RealDisk → durable engine; None = memory only
     ):
         self.tag = tag
         self.log_config = log_config
@@ -63,6 +65,15 @@ class StorageServer:
         self._followed_epoch = -1
         self.process = None
         self._cursor = None
+        self.engine = (
+            KeyValueStoreMemory(disk, f"storage-{uid}") if disk is not None else None
+        )
+        # version-ordered ops awaiting durability: ("mut", v, m) |
+        # ("rows", v, rows) | ("own", v, (begin, end, persist_state))
+        self._durable_queue: list = []
+        # range → None | ("owned", rv) | ("adding", mv, sources) as of the
+        # durable version — what reboot recovery restores
+        self._persist_owned = KeyRangeMap(default=None)
         # shard ownership: range → None (not ours) | ("owned", ready_version)
         # | ("adding", since_version) — the reference's shards map with
         # AddingShard state (storageserver.actor.cpp:1761 fetchKeys)
@@ -119,6 +130,9 @@ class StorageServer:
                 )
                 self.data.rollback_after(boundary)
                 self._rollback_shard_state(boundary)
+                self._durable_queue = [
+                    e for e in self._durable_queue if e[1] <= boundary
+                ]
                 self.version.set(boundary)
         self._followed_epoch = cfg.epoch
 
@@ -191,15 +205,41 @@ class StorageServer:
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)
         elif m.type == MutationType.CLEAR_RANGE:
-            self.data.clear_range(m.param1, m.param2, version)
+            self._window_clear(m.param1, m.param2, version)
         elif m.is_atomic():
-            newv = apply_atomic(m.type, self.data.latest(m.param1), m.param2)
+            newv = apply_atomic(m.type, self._latest_value(m.param1), m.param2)
             if newv is None:
-                self.data.clear_range(m.param1, m.param1 + b"\x00", version)
+                self._window_clear(m.param1, m.param1 + b"\x00", version)
             else:
                 self.data.set(m.param1, newv, version)
         else:
             raise AssertionError(f"storage can't apply {m!r}")
+        if self.engine is not None:
+            self._durable_queue.append(("mut", version, m))
+
+    def _latest_value(self, key: bytes):
+        """Base value for atomic ops: the window's newest entry, falling
+        through to the engine for keys the durability advance dropped
+        (drop_known) — else the in-memory result diverges from the
+        engine's replay of the same op."""
+        h = self.data._hist.get(key)
+        if h:
+            return h[-1][1]
+        if self.engine is not None:
+            return self.engine.read_value(key)
+        return None
+
+    def _window_clear(self, begin: bytes, end: bytes, version: Version) -> None:
+        """Clear in the MVCC window, tombstoning engine-resident keys too:
+        a key dropped to the engine by drop_known has no window entry, so
+        VersionedMap.clear_range alone would leave reads falling through
+        to the engine's (pre-clear) value until the next durability
+        advance."""
+        if self.engine is not None:
+            for k, _v in self.engine.read_range(begin, end):
+                if k not in self.data._hist:
+                    self.data._append(k, version, None)
+        self.data.clear_range(begin, end, version)
 
     def _buffer_key_for(self, k: bytes):
         for (b, e) in self._fetch_buffers:
@@ -238,6 +278,14 @@ class StorageServer:
             self.owned.insert(begin, end, ("adding", version))
             self._fetch_buffers[(begin, end)] = []
             self._fetch_info[(begin, end)] = (tuple(info["old_addrs"]), version)
+            if self.engine is not None:
+                self._durable_queue.append(
+                    (
+                        "own",
+                        version,
+                        (begin, end, ("adding", version, tuple(info["old_addrs"]))),
+                    )
+                )
             self.process.spawn(
                 self._fetch_keys(begin, end, info["old_addrs"], version)
             )
@@ -256,7 +304,20 @@ class StorageServer:
             self.owned.insert(begin, end, None)
             self._fetch_buffers.pop((begin, end), None)
             self._fetch_info.pop((begin, end), None)
-            self.data.clear_range(begin, end or b"\xff\xff\xff\xff\xff", version)
+            self._window_clear(begin, end or b"\xff\xff\xff\xff\xff", version)
+            if self.engine is not None:
+                self._durable_queue.append(("own", version, (begin, end, None)))
+                self._durable_queue.append(
+                    (
+                        "mut",
+                        version,
+                        Mutation(
+                            MutationType.CLEAR_RANGE,
+                            begin,
+                            end or b"\xff\xff\xff\xff\xff",
+                        ),
+                    )
+                )
 
     async def _fetch_keys(self, begin, end, sources, move_version):
         """Fetch [begin, end) from the old team at a snapshot, splice the
@@ -321,6 +382,13 @@ class StorageServer:
         for k in sorted(state):
             self.data.set(k, state[k], ready_version)
         self.owned.insert(begin, end, ("owned", ready_version))
+        if self.engine is not None:
+            self._durable_queue.append(
+                ("rows", ready_version, sorted(state.items()))
+            )
+            self._durable_queue.append(
+                ("own", ready_version, (begin, end, ("owned", ready_version)))
+            )
         trace(
             SevInfo,
             "FetchKeysDone",
@@ -341,14 +409,117 @@ class StorageServer:
                 self.version.get() - self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS,
             )
             if new_durable > self.durable_version:
+                if self.engine is not None:
+                    await self._make_durable(new_durable)
                 self.durable_version = new_durable
-                self.data.forget_before(new_durable)
+                self.data.forget_before(
+                    new_durable, drop_known=self.engine is not None
+                )
                 # shard events below the horizon can no longer roll back
                 self._shard_events = [
                     e for e in self._shard_events if e[0] > new_durable
                 ]
             if self._cursor is not None:
-                await self._cursor.pop(self.version.get())
+                # with a durable engine, tlogs may discard only what we've
+                # PERSISTED — a reboot replays (durable, version] from them
+                pop_to = (
+                    self.durable_version if self.engine is not None
+                    else self.version.get()
+                )
+                await self._cursor.pop(pop_to)
+
+    async def _make_durable(self, new_durable: Version) -> None:
+        """Drain the op queue through `new_durable` into the engine and
+        commit, with the shard-assignment state as of that version — one
+        atomic durability advance (updateStorage:2536)."""
+        i = 0
+        q = self._durable_queue
+        while i < len(q) and q[i][1] <= new_durable:
+            kind, _v, payload = q[i]
+            if kind == "mut":
+                m = payload
+                if m.type == MutationType.SET_VALUE:
+                    self.engine.set(m.param1, m.param2)
+                elif m.type == MutationType.CLEAR_RANGE:
+                    self.engine.clear_range(m.param1, m.param2)
+                elif m.is_atomic():
+                    nv = apply_atomic(
+                        m.type, self.engine.read_value(m.param1), m.param2
+                    )
+                    if nv is None:
+                        self.engine.clear_range(m.param1, m.param1 + b"\x00")
+                    else:
+                        self.engine.set(m.param1, nv)
+            elif kind == "rows":
+                for k, v in payload:
+                    self.engine.set(k, v)
+            elif kind == "own":
+                begin, end, state = payload
+                self._persist_owned.insert(begin, end, state)
+            i += 1
+        del q[:i]
+        self.engine.set(b"\xff\xff/local/meta", self._encode_local_meta(new_durable))
+        await self.engine.commit()
+
+    def _encode_local_meta(self, durable: Version) -> bytes:
+        import json
+
+        entries = []
+        for b, e, state in self._persist_owned.ranges():
+            if state is None:
+                continue
+            entries.append(
+                [
+                    b.hex(),
+                    e.hex() if e is not None else None,
+                    list(state[:2]) + ([list(state[2])] if len(state) > 2 else []),
+                ]
+            )
+        return json.dumps({"durable": durable, "owned": entries}).encode()
+
+    async def _recover_durable_state(self) -> None:
+        """Reboot path (restoreDurableState, storageserver.actor.cpp:2765):
+        rows + shard assignment + durable version come back from the
+        engine; the mutation stream resumes just above it."""
+        await self.engine.recover()
+        blob = self.engine.read_value(b"\xff\xff/local/meta")
+        if blob is None:
+            return  # brand new store
+        import json
+
+        meta = json.loads(blob.decode())
+        durable = meta["durable"]
+        self.version.set(durable)
+        self.durable_version = durable
+        self.data.oldest_version = durable
+        self.data.latest_version = durable
+        # the engine's shard assignment supersedes the manifest's seed list
+        self.owned = KeyRangeMap(default=None)
+        for b_hex, e_hex, state in meta["owned"]:
+            begin = bytes.fromhex(b_hex)
+            end = bytes.fromhex(e_hex) if e_hex is not None else None
+            if state[0] == "owned":
+                self.owned.insert(begin, end, ("owned", min(state[1], durable)))
+                self._persist_owned.insert(begin, end, ("owned", state[1]))
+            elif state[0] == "adding":
+                sources = tuple(state[2]) if len(state) > 2 else ()
+                self.owned.insert(begin, end, ("adding", state[1]))
+                self._persist_owned.insert(
+                    begin, end, ("adding", state[1], sources)
+                )
+                self._fetch_buffers[(begin, end)] = []
+                self._fetch_info[(begin, end)] = (sources, state[1])
+                self.process.spawn(
+                    self._fetch_keys(begin, end, list(sources), state[1])
+                )
+        trace(
+            SevInfo,
+            "StorageRecovered",
+            self.process.address,
+            Tag=self.tag,
+            DurableVersion=durable,
+            Rows=len(self.engine),
+        )
 
     # -- version gate (waitForVersion:627) -------------------------------------
 
@@ -375,16 +546,48 @@ class StorageServer:
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
         await self._wait_for_version(req.version)
         self._check_read(req.key, req.key + b"\x00", req.version)
-        return GetValueReply(value=self.data.get(req.key, req.version))
+        known, value = self.data.get_with_presence(req.key, req.version)
+        if not known and self.engine is not None:
+            value = self.engine.read_value(req.key)
+        return GetValueReply(value=value)
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         await self._wait_for_version(req.version)
         self._check_read(req.begin, req.end, req.version)
-        data = self.data.range(
-            req.begin, req.end, req.version, limit=req.limit + 1, reverse=req.reverse
+        data = self._read_range_merged(
+            req.begin, req.end, req.version, req.limit + 1, req.reverse
         )
         more = len(data) > req.limit
         return GetKeyValuesReply(data=data[: req.limit], more=more)
+
+    def _read_range_merged(self, begin, end, version, limit, reverse):
+        """Window-over-engine merge (the reference's readRange:916 merge of
+        the in-memory versioned tree with the durable engine)."""
+        if self.engine is None:
+            return self.data.range(
+                begin, end, version, limit=limit, reverse=reverse
+            )
+        win = self.data.entries_with_tombstones(begin, end, version)
+        overlay = dict(win)
+        want = limit + len(win) + 1
+        while True:
+            base = self.engine.read_range(begin, end, limit=want)
+            merged = {k: v for k, v in base}
+            for k, v in overlay.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            rows = sorted(merged.items(), reverse=reverse)
+            exhausted = len(base) < want
+            if reverse and not exhausted:
+                # forward-limited engine read can't bound a reverse scan;
+                # fall back to the full range (rare path)
+                want = 1 << 30
+                continue
+            if len(rows) >= limit or exhausted:
+                return rows[:limit]
+            want *= 2
 
     async def get_shard_state(self, req) -> bool:
         """Is [begin, end) fully owned and readable? (the mover's readiness
@@ -400,11 +603,12 @@ class StorageServer:
     # -- wiring ----------------------------------------------------------------
 
     async def _get_version(self, _req):
-        """(version, followed_epoch): the epoch qualifies the version — a
-        raw version may still include a pre-recovery tail this server has
-        not rolled back yet (it only rolls back once it sees the new
-        epoch's config), so catch-up decisions must check the epoch too."""
-        return (self.version.get(), self._followed_epoch)
+        """(version, durable_version, followed_epoch). The epoch qualifies
+        the version — a raw version may still include a pre-recovery tail
+        this server has not rolled back yet (it only rolls back once it
+        sees the new epoch's config); durable_version is what a reboot
+        would come back with (old tlog generations must outlive it)."""
+        return (self.version.get(), self.durable_version, self._followed_epoch)
 
     def register_endpoints(self, process) -> None:
         self.process = process
@@ -419,6 +623,19 @@ class StorageServer:
         self.register_endpoints(process)
         process.spawn(self.pull_loop())
         process.spawn(self.durability_loop())
+
+    async def run(self):
+        """Worker-hosted lifetime: recover durable state first, then pull
+        and persist until cancelled (role destroy / process kill)."""
+        if self.engine is not None:
+            await self._recover_durable_state()
+        a = self.process.spawn(self.pull_loop())
+        b = self.process.spawn(self.durability_loop())
+        try:
+            await forever()
+        finally:
+            a.cancel()
+            b.cancel()
 
     async def _ping(self, _req):
         return "pong"

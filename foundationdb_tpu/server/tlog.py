@@ -21,6 +21,7 @@ persistence joins with the storage-engine stage (SURVEY.md §7 stage 7).
 from __future__ import annotations
 
 import bisect
+from ..kv.diskqueue import DiskQueue
 from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from .systemdata import TXS_TAG
@@ -49,6 +50,7 @@ class TLog:
         epoch: int = 0,
         log_id: str = "",
         first_version: Version = 0,
+        disk=None,  # SimDisk/RealDisk → DiskQueue persistence; None = modeled
     ):
         self.knobs = knobs or Knobs()
         self.tags = tags  # tags this tlog stores; None = all
@@ -66,6 +68,38 @@ class TLog:
         # duplicates await it instead of acking early
         self._pending: dict[Version, Future] = {}
         self._popped: dict[int, Version] = {}  # tag → popped-through version
+        self.dq = DiskQueue(disk, f"tlog-{log_id}") if disk is not None else None
+        # every pushed dq entry (incl. empty versions), ascending:
+        # [(version, start_offset, end_offset)]
+        self._dq_index: list[tuple[Version, int, int]] = []
+        self._pops_since_compact = 0
+
+    async def recover(self) -> None:
+        """Rebuild from the DiskQueue after a reboot
+        (restorePersistentState:1547). A recovered tlog rejoins *stopped*:
+        its generation missed pushes while it was down, so the version
+        chain has a gap only a full recovery can close — it serves peeks
+        and locks (its durable data still counts toward the epoch-end)
+        but accepts no new commits."""
+        assert self.dq is not None
+        entries = await self.dq.recover()
+        from ..runtime.serialize import read_tagged_messages
+
+        last = self.version.get()
+        for i, (offset, payload) in enumerate(entries):
+            version, messages = read_tagged_messages(payload)
+            end = (
+                entries[i + 1][0] if i + 1 < len(entries) else self.dq._buffer_base
+            )
+            self._dq_index.append((version, offset, end))
+            if messages:
+                self._log.append((version, messages))
+                self._versions.append(version)
+            last = max(last, version)
+        self.version.set(last)
+        self._gate.advance_to(last)
+        self.stopped = True
+        self.locked_by_epoch = self.epoch
 
     async def commit(self, req: TLogCommitRequest):
         if self.stopped:
@@ -95,7 +129,18 @@ class TLog:
             if msgs:
                 self._log.append((req.version, msgs))
                 self._versions.append(req.version)
-            await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
+            if self.dq is not None:
+                # every version is logged (even empty): the durable high
+                # water mark must survive reboot or the epoch-end rule
+                # would discard acknowledged versions this tlog acked
+                # while holding no payload for them
+                from ..runtime.serialize import write_tagged_messages
+
+                offset = self.dq.push(write_tagged_messages(req.version, msgs))
+                self._dq_index.append((req.version, offset, self.dq._end))
+                await self.dq.commit()
+            else:
+                await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
             durable._set(None)
         finally:
             # on cancellation (process kill) the version must not stay
@@ -149,14 +194,47 @@ class TLog:
         prev = self._popped.get(req.tag, 0)
         if req.upto > prev:
             self._popped[req.tag] = req.upto
-            self._trim()
+            horizon = self._trim()
+            if self.dq is not None and horizon is not None:
+                j = bisect.bisect_right(
+                    [v for v, _o, _e in self._dq_index], horizon
+                )
+                if j:
+                    # pop to the start of the first retained entry, or the
+                    # END of the last one when everything is retired (a
+                    # mid-entry frontier would make the compacted file
+                    # start with a torn fragment and recovery would
+                    # discard everything after it)
+                    if j < len(self._dq_index):
+                        self.dq.pop(self._dq_index[j][1])
+                    else:
+                        self.dq.pop(self._dq_index[-1][2])
+                    del self._dq_index[:j]
+                    self._pops_since_compact += 1
+                    # compact only with no commit in flight: compaction
+                    # rewrites offsets and must not interleave with pushes
+                    if (
+                        self._pops_since_compact >= 64
+                        and not self.stopped
+                        and not self._pending
+                    ):
+                        self._pops_since_compact = 0
+                        await self.dq.commit()
+                        if not self._pending:
+                            shift = await self.dq.compact()
+                            if shift:
+                                self._dq_index = [
+                                    (v, o - shift, e - shift)
+                                    for v, o, e in self._dq_index
+                                ]
         return None
 
-    def _trim(self) -> None:
+    def _trim(self):
         """Drop log entries every tag has popped past (reference: DiskQueue
-        pop location advancing once all tags acknowledge)."""
+        pop location advancing once all tags acknowledge). Returns the
+        trim horizon (or None)."""
         if not self._log:
-            return
+            return None
         # a tag with data but no pop record pins the log
         live_tags = set()
         for _, msgs in self._log:
@@ -166,6 +244,7 @@ class TLog:
         if i:
             del self._log[:i]
             del self._versions[:i]
+        return horizon
 
     def register_instance(self, process) -> None:
         """Id-suffixed tokens: many generations can share a worker."""

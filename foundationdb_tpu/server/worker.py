@@ -70,13 +70,52 @@ class Worker:
     def start(self) -> None:
         p = self.process
         p.worker = self  # test/ops introspection (the worker IS the process)
+        self.disk = p.sim.disk(p.machine)
         p.register(Tokens.WORKER_RECRUIT, self.recruit)
         p.register(Tokens.WORKER_SET_DB_INFO, self.set_db_info)
         p.register(Tokens.WORKER_PING, self._ping)
+        p.spawn(self._rescan_disk())  # reboot: resurrect durable roles
         p.spawn(monitor_leader(p, self.coordinators, self.leader))
         p.spawn(self._registration_client())
         if self.can_be_cc:
             p.spawn(self._cc_campaign())
+
+    # -- durable-role resurrection (worker.actor.cpp's data-dir scan) -----------
+
+    async def _rescan_disk(self):
+        import json
+
+        for name in self.disk.list():
+            if not name.startswith("manifest-"):
+                continue
+            f = self.disk.open(name)
+            try:
+                m = json.loads((await f.read(0, f.size())).decode())
+            except Exception:
+                continue
+            if m["uid"] in self.roles:
+                continue
+            params = dict(m["params"])
+            params["recover"] = True
+            trace(
+                SevInfo,
+                "ResurrectingRole",
+                self.process.address,
+                Kind=m["kind"],
+                Uid=m["uid"],
+            )
+            await self.recruit(
+                RecruitRoleRequest(role=m["kind"], uid=m["uid"], params=params)
+            )
+
+    async def _write_manifest(self, kind: str, uid: str, params: dict):
+        import json
+
+        f = self.disk.open(f"manifest-{uid}")
+        blob = json.dumps({"kind": kind, "uid": uid, "params": params}).encode()
+        await f.truncate(0)
+        await f.write(0, blob)
+        await f.sync()
 
     async def _ping(self, _req):
         return "pong"
@@ -176,6 +215,13 @@ class Worker:
         # recruitment returned (the master does, mid-recovery) — sweep them
         for token in [t for t in self.process.endpoints if t.endswith(f"#{uid}")]:
             self.process.endpoints.pop(token, None)
+        if h.kind == "tlog" and getattr(self, "disk", None) is not None:
+            # a destroyed tlog generation's durable state must not be
+            # resurrected on the next reboot
+            self.disk.remove(f"manifest-{uid}")
+            for name in list(self.disk.list()):
+                if name.startswith(f"tlog-{uid}."):
+                    self.disk.remove(name)
         for a in h.actors:
             a.cancel()
         trace(
@@ -211,18 +257,45 @@ class Worker:
         h.actors.append(fut)
         return fut
 
-    def _make_tlog(self, h, epoch=0, tags=None, first_version=0):
+    def _make_tlog(self, h, epoch=0, tags=None, first_version=0, recover=False):
         from .tlog import TLog
 
+        if isinstance(tags, list):
+            tags = frozenset(tags)
         tl = TLog(
             self.knobs,
             tags=tags,
             epoch=epoch,
             log_id=h.uid,
             first_version=first_version,
+            disk=self.disk,
         )
         h.epoch, h.obj = epoch, tl
-        tl.register_instance(self.process)
+        if recover:
+            # serve only after the DiskQueue replay: a peek against an
+            # empty index would understate this replica's durable version
+            async def recover_then_serve():
+                await tl.recover()
+                tl.register_instance(self.process)
+
+            self._spawn(h, recover_then_serve())
+        else:
+            # the manifest must be durable BEFORE the tlog can ack a
+            # commit — otherwise a kill in the window leaves acked data
+            # on disk that reboot never resurrects (no manifest, no role)
+            async def manifest_then_serve():
+                await self._write_manifest(
+                    "tlog",
+                    h.uid,
+                    dict(
+                        epoch=epoch,
+                        tags=sorted(tags) if tags is not None else None,
+                        first_version=first_version,
+                    ),
+                )
+                tl.register_instance(self.process)
+
+            self._spawn(h, manifest_then_serve())
 
     def _make_resolver(self, h, backend="oracle", first_version=0, epoch=0):
         from .resolver import Resolver
@@ -260,7 +333,7 @@ class Worker:
         self._spawn(h, pr.batcher_loop())
         self._spawn(h, pr.rate_poller())
 
-    def _make_storage(self, h, tag=0, ranges=None):
+    def _make_storage(self, h, tag=0, ranges=None, recover=False):
         from .storage import StorageServer
 
         # storage keeps well-known data tokens: strictly one per process
@@ -269,17 +342,44 @@ class Worker:
         if others:
             del self.roles[h.uid]
             raise RuntimeError(f"{self.process.address} already hosts storage")
+        if ranges is not None and ranges and isinstance(ranges[0][0], str):
+            ranges = [
+                (
+                    bytes.fromhex(b),
+                    bytes.fromhex(e) if e is not None else None,
+                )
+                for b, e in ranges
+            ]
         ss = StorageServer(
             tag=tag,
             log_config=self.log_config,
             knobs=self.knobs,
             uid=h.uid,
-            owned_ranges=ranges,
+            owned_ranges=ranges if ranges is not None else [],
+            disk=self.disk,
         )
         h.obj = ss
         ss.register_endpoints(self.process)
-        self._spawn(h, ss.pull_loop())
-        self._spawn(h, ss.durability_loop())
+        if recover:
+            self._spawn(h, ss.run())
+        else:
+            # manifest first: once running, a durability advance pops the
+            # tlogs — data a reboot could only recover through the manifest
+            async def manifest_then_run():
+                await self._write_manifest(
+                    "storage",
+                    h.uid,
+                    dict(
+                        tag=tag,
+                        ranges=[
+                            [b.hex(), e.hex() if e is not None else None]
+                            for b, e in (ranges or [])
+                        ],
+                    ),
+                )
+                await ss.run()
+
+            self._spawn(h, manifest_then_run())
 
     def _make_master(self, h, coordinators=None, cc_address="", initial_config=None):
         from .master import MasterTerminated, master_core
