@@ -148,6 +148,50 @@ class Database:
         b, e, _ = self._locations.range_for(key)
         self._locations.insert(b, e, None)
 
+    # -- watches ---------------------------------------------------------------
+
+    def watch(self, key: bytes):
+        """Fire when the key's value changes from its current value."""
+        from ..runtime.futures import Future
+
+        out = Future()
+        self.client.spawn(self._watch_actor(key, out))
+        return out
+
+    async def _watch_actor(self, key: bytes, out) -> None:
+        """Register (and keep re-registering across failovers/moves) a
+        storage watch; resolve `out` with the new value."""
+        from ..errors import FdbError
+        from ..server.interfaces import Tokens as T
+        from ..server.interfaces import WatchValueRequest
+
+        baseline_known = False
+        v0 = None
+        while not out.is_ready():
+            try:
+                tr = self.transaction()
+                if not baseline_known:
+                    # the baseline is captured ONCE: a change landing
+                    # during a failover retry must still fire the watch,
+                    # not silently become the new baseline
+                    v0 = await tr.get(key, snapshot=True)
+                    baseline_known = True
+                else:
+                    await tr.get_read_version()
+                req = WatchValueRequest(
+                    key=key, value=v0, version=tr._read_version
+                )
+                reply = await tr._load_balanced(key, T.WATCH_VALUE, req)
+                if not out.is_ready():
+                    out._set(reply.value)
+                return
+            except (FdbError, BrokenPromise):
+                await delay(0.1)
+            except Exception as e:
+                if not out.is_ready():
+                    out._set_error(e)
+                return
+
     # -- transactions ----------------------------------------------------------
 
     def transaction(self) -> Transaction:

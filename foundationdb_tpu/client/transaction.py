@@ -71,6 +71,7 @@ class Transaction:
         self._rcr: list[tuple[bytes, bytes]] = []
         self._wcr: list[tuple[bytes, bytes]] = []
         self._unreadable: set[bytes] = set()  # versionstamped-key placeholders
+        self._watches: list[tuple[bytes, object]] = []  # (key, Future)
         self.committed_version: Optional[int] = None
         self.versionstamp: Optional[bytes] = None
 
@@ -136,6 +137,16 @@ class Transaction:
         )
         self._unreadable.add(key)
         self._wcr.append((key, key_after(key)))
+
+    def watch(self, key: bytes):
+        """A future that fires when the key's value changes after this
+        transaction commits (fdb_transaction_watch; NativeAPI watches via
+        storage watchValue). Await it only after a successful commit."""
+        from ..runtime.futures import Future
+
+        out = Future()
+        self._watches.append((key, out))
+        return out
 
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
         self._rcr.append((begin, end))
@@ -329,6 +340,7 @@ class Transaction:
         if not self._mutations and not self._wcr:
             # read-only: committing at the read version with no writes
             self.committed_version = self._read_version or 0
+            self._start_watches()
             return self.committed_version
         data = TransactionData(
             read_snapshot=await self.get_read_version() if self._rcr else 0,
@@ -346,7 +358,13 @@ class Transaction:
             raise CommitUnknownResult()
         self.committed_version = reply.version
         self.versionstamp = reply.versionstamp
+        self._start_watches()
         return reply.version
+
+    def _start_watches(self) -> None:
+        for key, fut in self._watches:
+            self.db.client.spawn(self.db._watch_actor(key, fut))
+        self._watches = []
 
     def get_versionstamp(self) -> bytes:
         assert self.committed_version is not None, "commit first"

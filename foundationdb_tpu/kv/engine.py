@@ -35,6 +35,7 @@ class KeyValueStoreMemory:
         self._map: dict[bytes, bytes] = {}
         self._ops = BinaryWriter()
         self._ops_count = 0
+        self._oplog_bytes = 0  # op-log bytes since the last snapshot
 
     # -- recovery --------------------------------------------------------------
 
@@ -85,11 +86,18 @@ class KeyValueStoreMemory:
 
     async def commit(self) -> None:
         if self._ops_count:
-            self.dq.push(self._ops.data())
+            blob = self._ops.data()
+            self._oplog_bytes += len(blob)
+            self.dq.push(blob)
             self._ops = BinaryWriter()
             self._ops_count = 0
         await self.dq.commit()
-        if self.dq.bytes_used > self.SNAPSHOT_AFTER_BYTES:
+        # snapshot when the op-log since the last snapshot dominates —
+        # comparing against total queue bytes would re-snapshot the whole
+        # dataset on every commit once it exceeds a fixed threshold
+        if self._oplog_bytes > max(
+            self.SNAPSHOT_AFTER_BYTES, self.dq.bytes_used - self._oplog_bytes
+        ):
             await self._snapshot()
 
     async def _snapshot(self) -> None:
@@ -102,6 +110,7 @@ class KeyValueStoreMemory:
         self.dq.pop(offset)
         await self.dq.commit()
         await self.dq.compact()
+        self._oplog_bytes = 0
 
     # -- reads -----------------------------------------------------------------
 

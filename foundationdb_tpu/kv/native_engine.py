@@ -55,6 +55,7 @@ def _load():
         ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.bt_cursor_current.argtypes = lib.bt_cursor_next.argtypes
     lib.bt_cursor_close.argtypes = [ctypes.c_void_p]
     lib.bt_stats.argtypes = [
         ctypes.c_void_p,
@@ -82,7 +83,9 @@ class KeyValueStoreBTree:
         pass  # bt_open already recovered the latest committed epoch
 
     def set(self, key: bytes, value: bytes) -> None:
-        self._lib.bt_set(self._h, key, len(key), value, len(value))
+        rc = self._lib.bt_set(self._h, key, len(key), value, len(value))
+        if rc != 0:
+            raise ValueError(f"bt_set failed (rc={rc}; key too large?)")
 
     def clear_range(self, begin: bytes, end: bytes) -> None:
         self._lib.bt_clear_range(self._h, begin, len(begin), end, len(end))
@@ -93,9 +96,14 @@ class KeyValueStoreBTree:
             raise OSError(f"bt_commit failed: {rc}")
 
     def read_value(self, key: bytes):
-        n = self._lib.bt_get(self._h, key, len(key), self._vbuf, _MAX_VALUE)
+        n = self._lib.bt_get(self._h, key, len(key), self._vbuf, len(self._vbuf))
         if n < 0:
             return None
+        if n > len(self._vbuf):
+            # value larger than the buffer: grow and re-read (bt_get never
+            # truncates silently — it reports the true length)
+            self._vbuf = ctypes.create_string_buffer(int(n))
+            n = self._lib.bt_get(self._h, key, len(key), self._vbuf, len(self._vbuf))
         return self._vbuf.raw[:n]
 
     def read_range(self, begin: bytes, end: bytes, limit: int = 1 << 30):
@@ -104,11 +112,26 @@ class KeyValueStoreBTree:
         klen = ctypes.c_int64()
         vlen = ctypes.c_int64()
         try:
-            while len(out) < limit and self._lib.bt_cursor_next(
-                cur,
-                self._kbuf, 1 << 14, ctypes.byref(klen),
-                self._vbuf, _MAX_VALUE, ctypes.byref(vlen),
-            ):
+            while len(out) < limit:
+                rc = self._lib.bt_cursor_next(
+                    cur,
+                    self._kbuf, len(self._kbuf), ctypes.byref(klen),
+                    self._vbuf, len(self._vbuf), ctypes.byref(vlen),
+                )
+                if rc == 0:
+                    break
+                if rc == -1:
+                    # row held in the cursor; grow and re-copy
+                    if klen.value > len(self._kbuf):
+                        self._kbuf = ctypes.create_string_buffer(int(klen.value))
+                    if vlen.value > len(self._vbuf):
+                        self._vbuf = ctypes.create_string_buffer(int(vlen.value))
+                    rc = self._lib.bt_cursor_current(
+                        cur,
+                        self._kbuf, len(self._kbuf), ctypes.byref(klen),
+                        self._vbuf, len(self._vbuf), ctypes.byref(vlen),
+                    )
+                    assert rc == 1
                 out.append(
                     (self._kbuf.raw[: klen.value], self._vbuf.raw[: vlen.value])
                 )

@@ -165,7 +165,9 @@ class VersionedMap:
         drop_known=True additionally drops entries ≤ version entirely —
         correct only when a durable engine holds the state at `version`
         and reads fall through to it (get_with_presence)."""
-        if version <= self.oldest_version:
+        if version < self.oldest_version or (
+            version == self.oldest_version and not drop_known
+        ):
             return
         version = min(version, self.latest_version)
         dead: list[bytes] = []

@@ -35,11 +35,14 @@
 
 namespace {
 
-constexpr uint32_t PAGE_SIZE = 4096;
+constexpr uint32_t PAGE_SIZE = 32768;
 constexpr uint32_t META_MAGIC = 0xFDB7B7EE;
 constexpr uint16_t T_LEAF = 1, T_INTERNAL = 2, T_OVERFLOW = 3;
 // payload capacity of a page after the header
 constexpr uint32_t CAP = PAGE_SIZE - 8;
+// the reference caps keys at 10 KB (error 2102 key_too_large); here the
+// bound also guarantees any 3 separators + children fit one internal page
+constexpr uint32_t MAX_KEY = 8192;
 
 static uint32_t crc32sw(const uint8_t* p, size_t n) {
   static uint32_t table[256];
@@ -557,6 +560,7 @@ void bt_close(void* h) {
 
 int bt_set(void* h, const uint8_t* k, int klen, const uint8_t* v, int vlen) {
   auto* bt = (BTree*)h;
+  if ((uint32_t)klen > MAX_KEY) return -100;  // key_too_large
   bt->set(std::string((const char*)k, klen), std::string((const char*)v, vlen));
   return 0;
 }
@@ -597,15 +601,32 @@ void* bt_range_open(void* h, const uint8_t* b, int blen, const uint8_t* e,
   return c;
 }
 
-// 1 = produced a row; 0 = exhausted. key/value copied into the buffers.
+// 1 = produced a row (copied); 0 = exhausted; -1 = buffers too small —
+// the row is HELD in the cursor: grow the buffers and call
+// bt_cursor_current, never silently truncated.
 int bt_cursor_next(void* hc, uint8_t* kout, int64_t kcap, int64_t* klen,
                    uint8_t* vout, int64_t vcap, int64_t* vlen) {
   auto* c = (Cursor*)hc;
   if (!c->next()) return 0;
   *klen = (int64_t)c->cur_key.size();
   *vlen = (int64_t)c->cur_val.size();
-  if ((int64_t)c->cur_key.size() <= kcap) memcpy(kout, c->cur_key.data(), c->cur_key.size());
-  if ((int64_t)c->cur_val.size() <= vcap) memcpy(vout, c->cur_val.data(), c->cur_val.size());
+  if ((int64_t)c->cur_key.size() > kcap || (int64_t)c->cur_val.size() > vcap)
+    return -1;
+  memcpy(kout, c->cur_key.data(), c->cur_key.size());
+  memcpy(vout, c->cur_val.data(), c->cur_val.size());
+  return 1;
+}
+
+// re-copy the row held after a -1 from bt_cursor_next
+int bt_cursor_current(void* hc, uint8_t* kout, int64_t kcap, int64_t* klen,
+                      uint8_t* vout, int64_t vcap, int64_t* vlen) {
+  auto* c = (Cursor*)hc;
+  *klen = (int64_t)c->cur_key.size();
+  *vlen = (int64_t)c->cur_val.size();
+  if ((int64_t)c->cur_key.size() > kcap || (int64_t)c->cur_val.size() > vcap)
+    return -1;
+  memcpy(kout, c->cur_key.data(), c->cur_key.size());
+  memcpy(vout, c->cur_val.data(), c->cur_val.size());
   return 1;
 }
 

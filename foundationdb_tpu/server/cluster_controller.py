@@ -42,6 +42,7 @@ class ClusterController:
         self.db_info = AsyncVar(None)  # AsyncVar[ServerDBInfo]
         self._actors = []
         self._master_n = 0
+        self._master_at: tuple = None  # (worker address, uid) of current master
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -52,6 +53,8 @@ class ClusterController:
         p.register(Tokens.CC_OPEN_DATABASE, self.open_database)
         p.register(Tokens.CC_SET_DB_INFO, self.set_db_info)
         p.register(Tokens.CC_GET_DB_INFO, self.get_db_info)
+        p.register(Tokens.CC_GET_STATUS, self.get_status)
+        p.register(Tokens.CC_FORCE_RECOVERY, self.force_recovery)
         self._actors.append(p.spawn(self.cluster_watch_database()))
         self._actors.append(p.spawn(self._broadcast_loop()))
 
@@ -62,6 +65,8 @@ class ClusterController:
             Tokens.CC_OPEN_DATABASE,
             Tokens.CC_SET_DB_INFO,
             Tokens.CC_GET_DB_INFO,
+            Tokens.CC_GET_STATUS,
+            Tokens.CC_FORCE_RECOVERY,
         ):
             self.process.endpoints.pop(t, None)
         for a in self._actors:
@@ -125,6 +130,7 @@ class ClusterController:
                 Worker=target.address,
                 Uid=uid,
             )
+            self._master_at = (target.address, uid)
             # watch it: the master's ping endpoint vanishes when it dies
             ping = Endpoint(target.address, f"master.ping#{uid}")
             misses = 0
@@ -156,25 +162,90 @@ class ClusterController:
         heartbeat — a rebooted worker re-registers under the same address
         and must get the current info again (workers dedupe by id), so no
         per-address sent-cache here."""
+        async def send_one(address, info):
+            try:
+                await timeout(
+                    self.process.request(
+                        Endpoint(address, Tokens.WORKER_SET_DB_INFO),
+                        SetDBInfoRequest(info=info),
+                    ),
+                    1.0,
+                )
+            except Exception:
+                pass
+
         while True:
             info = self.db_info.get()
             if info is not None:
-                for d in self._alive_workers():
-                    try:
-                        await timeout(
-                            self.process.request(
-                                Endpoint(d.address, Tokens.WORKER_SET_DB_INFO),
-                                SetDBInfoRequest(info=info),
-                            ),
-                            1.0,
-                        )
-                    except Exception:
-                        pass
+                # parallel: a dead-but-registered worker's timeout must not
+                # serially delay everyone listed after it
+                from ..runtime.futures import wait_for_all
+
+                await wait_for_all(
+                    [
+                        self.process.spawn(send_one(d.address, info))
+                        for d in self._alive_workers()
+                    ]
+                )
             change = self.db_info.on_change()
             await_any = [change, delay(self.knobs.HEARTBEAT_INTERVAL)]
             from ..runtime.futures import wait_for_any
 
             await wait_for_any(await_any)
+
+    # -- operator actions --------------------------------------------------------
+
+    async def force_recovery(self, _req):
+        """Kill the current master role; the watch loop recruits a fresh
+        one, which runs a full recovery (picking up config changes)."""
+        if self._master_at is None:
+            return False
+        addr, uid = self._master_at
+        try:
+            await timeout(
+                self.process.request(
+                    Endpoint(addr, Tokens.WORKER_DESTROY_ROLE), uid
+                ),
+                2.0,
+            )
+        except Exception:
+            pass
+        trace(SevInfo, "ForcedRecovery", self.process.address, Master=uid)
+        return True
+
+    async def get_status(self, _req) -> dict:
+        """The cluster status document (Status.actor.cpp's aggregation,
+        trimmed to what this CC can see + quick storage polls)."""
+        info = self.db_info.get()
+        workers = {}
+        for d in self._alive_workers():
+            workers[d.address] = {
+                "class": d.process_class,
+                "roles": list(d.roles),
+            }
+        doc = {
+            "cluster": {
+                "controller": self.process.address,
+                "recovery_count": info.recovery_count if info else 0,
+                "recovered": info is not None,
+                "master": info.master_address if info else None,
+                "workers": workers,
+                "coordinators": list(self.coordinators),
+            },
+            "data": {},
+            "qos": {},
+        }
+        if info is not None and info.log_system is not None:
+            ls = info.log_system
+            doc["cluster"]["logs"] = {
+                "epoch": ls.epoch,
+                "current": [log.log_id for log in ls.current.logs],
+                "old_generations": len(ls.old),
+            }
+            doc["client"] = {
+                "proxies": [p.address for p in info.client_info.proxies]
+            }
+        return doc
 
     # -- client openDatabase -----------------------------------------------------
 

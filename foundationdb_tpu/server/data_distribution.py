@@ -121,8 +121,26 @@ class DataDistributor:
                 return out
             key = reply.end
 
+    async def _get_excluded(self) -> set:
+        from ..client.management import EXCLUDED_PREFIX
+
+        async def body(tr):
+            rows = await tr.get_range(
+                EXCLUDED_PREFIX, EXCLUDED_PREFIX + b"\xff", snapshot=True
+            )
+            return {k[len(EXCLUDED_PREFIX) :].decode() for k, _v in rows}
+
+        try:
+            return await self.db.run(body, max_retries=3)
+        except Exception:
+            return set()
+
     async def _repair_once(self):
         shards = await self._walk_shards()
+        excluded_addrs = await self._get_excluded()
+        excluded_tags = {
+            s.tag for s in self.storage if s.address in excluded_addrs
+        }
         load = {s.tag: 0 for s in self.storage}
         for _b, _e, tags in shards:
             for t in tags:
@@ -135,6 +153,7 @@ class DataDistributor:
                 t
                 for t in tags
                 if not self.alive.get(t, False)
+                or t in excluded_tags
                 or self._unready.get((begin, t), 0) >= 4
             ]
             if not dead:
@@ -144,7 +163,7 @@ class DataDistributor:
                 (
                     t
                     for t, up in self.alive.items()
-                    if up and t not in tags
+                    if up and t not in tags and t not in excluded_tags
                 ),
                 key=lambda t: load[t],
             )

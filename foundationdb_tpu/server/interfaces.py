@@ -180,6 +180,22 @@ class GetValueReply:
 
 
 @dataclass
+class WatchValueRequest:
+    """Long-poll until the key's value differs from `value`
+    (watchValue_impl, storageserver.actor.cpp:758)."""
+
+    key: bytes = b""
+    value: Optional[bytes] = None  # the value the watcher believes is current
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class WatchValueReply:
+    value: Optional[bytes] = None  # the changed value
+    version: Version = INVALID_VERSION
+
+
+@dataclass
 class GetKeyValuesRequest:
     begin: bytes = b""
     end: bytes = b""
@@ -367,6 +383,7 @@ class Tokens:
     GET_VALUE = "storage.getValue"
     GET_KEY_VALUES = "storage.getKeyValues"
     GET_SHARD_STATE = "storage.getShardState"
+    WATCH_VALUE = "storage.watchValue"
     # worker
     WORKER_RECRUIT = "worker.recruit"
     WORKER_SET_DB_INFO = "worker.setDBInfo"
@@ -377,3 +394,6 @@ class Tokens:
     CC_OPEN_DATABASE = "cc.openDatabase"
     CC_SET_DB_INFO = "cc.setDBInfo"
     CC_GET_DB_INFO = "cc.getServerDBInfo"
+    CC_GET_STATUS = "cc.getStatus"
+    CC_FORCE_RECOVERY = "cc.forceRecovery"
+    WORKER_DESTROY_ROLE = "worker.destroyRole"

@@ -201,21 +201,18 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         await delay(0.6)
     picker = _RolePicker(workers, avoid={process.address})
 
-    n_storage = int(config.get("n_storage", 1))
-    n_tlogs = int(config.get("n_tlogs", 1))
-    n_resolvers = int(config.get("n_resolvers", 1))
-    n_proxies = int(config.get("n_proxies", 1))
-    replication = int(config.get("replication", 1))
-    tlog_replication = int(config.get("tlog_replication", 1))
-    backend = config.get("conflict_backend", "oracle")
-
     # storage: seeded once on a brand-new database, then immortal.
     # The live shard map = the coordinated-state snapshot + the txs-tag
     # deltas logged since (readTransactionSystemState — the reference's
-    # txnStateStore recovery from the log system).
+    # txnStateStore recovery from the log system). Conf mutations in the
+    # same stream update `config` — so this must run BEFORE the shape
+    # counts below are read (configure → forced recovery → new shape).
     if prev:
         storage = list(prev.storage)
         shard_map = ShardMap.from_list(prev.shards)
+        from .systemdata import CONF_PREFIX
+        from ..kv.mutations import MutationType
+
         for log in prev.tlog_set.logs:
             if log.log_id not in locks:
                 continue
@@ -228,9 +225,28 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
             for v, muts in reply.messages:
                 if v <= recovery_version:
                     apply_metadata_mutations(shard_map, muts)
+                    for m in muts:
+                        # configuration changes committed since the last
+                        # recovery shape THIS one (configure → recovery)
+                        if (
+                            m.type == MutationType.SET_VALUE
+                            and m.param1.startswith(CONF_PREFIX)
+                            and not m.param1.startswith(CONF_PREFIX + b"excluded/")
+                        ):
+                            name = m.param1[len(CONF_PREFIX) :].decode()
+                            config[name] = m.param2.decode()
             break  # txs rides every tlog; any locked one is complete
         shards = shard_map.to_list()
-    else:
+
+    n_storage = int(config.get("n_storage", 1))
+    n_tlogs = int(config.get("n_tlogs", 1))
+    n_resolvers = int(config.get("n_resolvers", 1))
+    n_proxies = int(config.get("n_proxies", 1))
+    replication = int(config.get("replication", 1))
+    tlog_replication = int(config.get("tlog_replication", 1))
+    backend = config.get("conflict_backend", "oracle")
+
+    if not prev:
         storage, shards = await _seed_storage(
             process, picker, n_storage, replication, uid
         )

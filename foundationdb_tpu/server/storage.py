@@ -33,6 +33,8 @@ from .interfaces import (
     GetValueRequest,
     Tokens,
     Version,
+    WatchValueReply,
+    WatchValueRequest,
 )
 from .log_system import PeekCursor
 from .systemdata import (
@@ -410,6 +412,13 @@ class StorageServer:
             )
             if new_durable > self.durable_version:
                 if self.engine is not None:
+                    # the engine is mutated ahead of the window compaction:
+                    # raise the window floor FIRST so a read at a version
+                    # below the new horizon fails too_old instead of
+                    # falling through to engine state newer than its
+                    # snapshot (reads in (old, new] horizons still have
+                    # their window entries until forget_before below)
+                    self.data.oldest_version = new_durable
                     await self._make_durable(new_durable)
                 self.durable_version = new_durable
                 self.data.forget_before(
@@ -572,7 +581,11 @@ class StorageServer:
         want = limit + len(win) + 1
         while True:
             base = self.engine.read_range(begin, end, limit=want)
-            merged = {k: v for k, v in base}
+            # the engine's local metadata rows (\xff\xff/local/...) are
+            # not data — they must not leak into client scans or fetchKeys
+            merged = {
+                k: v for k, v in base if not k.startswith(PRIVATE_PREFIX)
+            }
             for k, v in overlay.items():
                 if v is None:
                     merged.pop(k, None)
@@ -588,6 +601,21 @@ class StorageServer:
             if len(rows) >= limit or exhausted:
                 return rows[:limit]
             want *= 2
+
+    async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:
+        """Park until the key's value differs from the watcher's belief
+        (watchValue_impl:758). Fires on the version that changed it. The
+        shard moving away surfaces as wrong_shard_server and the client
+        re-registers at the new team."""
+        await self._wait_for_version(req.version)
+        while True:
+            self._check_read(req.key, req.key + b"\x00", self.version.get())
+            known, v = self.data.get_with_presence(req.key, self.version.get())
+            if not known and self.engine is not None:
+                v = self.engine.read_value(req.key)
+            if v != req.value:
+                return WatchValueReply(value=v, version=self.version.get())
+            await self.version.on_change()
 
     async def get_shard_state(self, req) -> bool:
         """Is [begin, end) fully owned and readable? (the mover's readiness
@@ -617,6 +645,7 @@ class StorageServer:
         process.register(f"storage.version#{self.uid}", self._get_version)
         process.register(f"storage.ping#{self.uid}", self._ping)
         process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
+        process.register(Tokens.WATCH_VALUE, self.watch_value)
         trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
 
     def register(self, process) -> None:

@@ -74,6 +74,7 @@ class Worker:
         p.register(Tokens.WORKER_RECRUIT, self.recruit)
         p.register(Tokens.WORKER_SET_DB_INFO, self.set_db_info)
         p.register(Tokens.WORKER_PING, self._ping)
+        p.register(Tokens.WORKER_DESTROY_ROLE, self._destroy_role_req)
         p.spawn(self._rescan_disk())  # reboot: resurrect durable roles
         p.spawn(monitor_leader(p, self.coordinators, self.leader))
         p.spawn(self._registration_client())
@@ -119,6 +120,11 @@ class Worker:
 
     async def _ping(self, _req):
         return "pong"
+
+    async def _destroy_role_req(self, uid: str):
+        """Operator-driven role destruction (the CC's forceRecovery)."""
+        self._destroy(uid)
+        return True
 
     # -- registration (registrationClient, worker.actor.cpp:253) ---------------
 

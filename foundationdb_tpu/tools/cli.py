@@ -1,0 +1,137 @@
+"""fdbcli analog: the operator shell, driving a Database + cluster
+controller with the same command vocabulary (fdbcli/fdbcli.actor.cpp —
+get/set/clear/getrange/status/configure/exclude/include/...).
+
+Commands are strings; `execute` returns the printed output, so the shell
+works both interactively and from tests/scripts (the sim is the
+deployment environment here, as everywhere in this codebase)."""
+
+from __future__ import annotations
+
+import json
+import shlex
+
+from ..client import management
+
+
+class FdbCli:
+    def __init__(self, db, coordinators: list[str] = None):
+        self.db = db
+        self.coordinators = coordinators or []
+
+    async def execute(self, line: str) -> str:
+        parts = shlex.split(line)
+        if not parts:
+            return ""
+        cmd, args = parts[0].lower(), parts[1:]
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            return f"ERROR: unknown command `{cmd}`"
+        try:
+            return await handler(args)
+        except Exception as e:
+            return f"ERROR: {e!r}"
+
+    # -- data ------------------------------------------------------------------
+
+    async def _cmd_get(self, args) -> str:
+        (key,) = args
+
+        async def body(tr):
+            return await tr.get(key.encode())
+
+        v = await self.db.run(body)
+        if v is None:
+            return f"`{key}': not found"
+        return f"`{key}' is `{v.decode(errors='replace')}'"
+
+    async def _cmd_set(self, args) -> str:
+        key, value = args
+
+        async def body(tr):
+            tr.set(key.encode(), value.encode())
+
+        await self.db.run(body)
+        return "Committed"
+
+    async def _cmd_clear(self, args) -> str:
+        (key,) = args
+
+        async def body(tr):
+            tr.clear(key.encode())
+
+        await self.db.run(body)
+        return "Committed"
+
+    async def _cmd_clearrange(self, args) -> str:
+        begin, end = args
+
+        async def body(tr):
+            tr.clear_range(begin.encode(), end.encode())
+
+        await self.db.run(body)
+        return "Committed"
+
+    async def _cmd_getrange(self, args) -> str:
+        begin, end = args[0], args[1]
+        limit = int(args[2]) if len(args) > 2 else 25
+
+        async def body(tr):
+            return await tr.get_range(begin.encode(), end.encode(), limit=limit)
+
+        rows = await self.db.run(body)
+        out = ["Range limited to {} keys".format(limit)]
+        for k, v in rows:
+            out.append(
+                f"`{k.decode(errors='replace')}' is"
+                f" `{v.decode(errors='replace')}'"
+            )
+        return "\n".join(out)
+
+    # -- ops -------------------------------------------------------------------
+
+    async def _cmd_status(self, args) -> str:
+        doc = await management.get_status(self.coordinators, self.db.client)
+        if args and args[0] == "json":
+            return json.dumps(doc, indent=2, default=str)
+        c = doc.get("cluster", {})
+        lines = [
+            f"Cluster controller: {c.get('controller')}",
+            f"Recovered: {c.get('recovered')} (recovery #{c.get('recovery_count')})",
+            f"Master: {c.get('master')}",
+            f"Workers: {len(c.get('workers', {}))}",
+            f"Coordinators: {', '.join(c.get('coordinators', []))}",
+        ]
+        logs = c.get("logs")
+        if logs:
+            lines.append(
+                f"Log epoch: {logs['epoch']} "
+                f"({len(logs['current'])} tlogs, "
+                f"{logs['old_generations']} old generations)"
+            )
+        proxies = doc.get("client", {}).get("proxies")
+        if proxies:
+            lines.append(f"Proxies: {', '.join(proxies)}")
+        return "\n".join(lines)
+
+    async def _cmd_exclude(self, args) -> str:
+        if not args:
+            ex = await management.get_excluded(self.db)
+            return "Excluded: " + (", ".join(ex) if ex else "(none)")
+        await management.exclude_servers(self.db, list(args))
+        await management.wait_for_excluded(self.db, list(args))
+        return f"Excluded {len(args)} server(s); data redistributed"
+
+    async def _cmd_include(self, args) -> str:
+        await management.include_servers(self.db, list(args) or None)
+        return "Included"
+
+    async def _cmd_configure(self, args) -> str:
+        changes = {}
+        for a in args:
+            k, _, v = a.partition("=")
+            changes[k] = v
+        await management.configure(
+            self.db, self.coordinators, self.db.client, **changes
+        )
+        return "Configuration changed; recovery triggered"

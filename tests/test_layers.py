@@ -1,0 +1,192 @@
+"""Tuple/Subspace/Directory layers + watches.
+
+Tuple encoding mirrors the bindings' spec (ordering preserved, round-trip
+exact); the directory layer allocates prefixes transactionally; watches
+fire on value change through the storage watchValue long-poll.
+"""
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.layers import DirectoryLayer, Subspace
+from foundationdb_tpu.layers import tuple as T
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn, timeout
+from foundationdb_tpu.server import Cluster, ClusterConfig
+
+
+def make_db(seed=0, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(**cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def drive(sim, coro, limit=120.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+# -- tuple --------------------------------------------------------------------
+
+
+def test_tuple_roundtrip():
+    cases = [
+        (),
+        (None,),
+        (b"bytes", "string", 0, 1, -1, 255, -255, 65536, -65536),
+        (1.5, -1.5, 0.0, float(10**10)),
+        (True, False),
+        (b"a\x00b", "emb\x00str"),
+        (("nested", (1, None, b"x")), 2),
+        (2**63 - 1, -(2**63) + 1),
+    ]
+    for t in cases:
+        assert T.unpack(T.pack(t)) == t, t
+
+
+def test_tuple_ordering_matches_value_order():
+    import random
+
+    rnd = random.Random(5)
+    vals = []
+    for _ in range(200):
+        kind = rnd.randrange(3)
+        if kind == 0:
+            vals.append((rnd.randrange(-10**9, 10**9),))
+        elif kind == 1:
+            vals.append((rnd.randrange(-10**9, 10**9), rnd.random()))
+        else:
+            vals.append(
+                (
+                    rnd.randrange(-100, 100),
+                    bytes(rnd.randrange(256) for _ in range(rnd.randrange(8))),
+                )
+            )
+    ints = sorted(v for v in vals if len(v) == 1)
+    packed = sorted(T.pack(v) for v in vals if len(v) == 1)
+    assert [T.unpack(p) for p in packed] == ints
+
+
+def test_subspace():
+    app = Subspace(("app",))
+    users = app["users"]
+    k = users.pack((42, "alice"))
+    assert users.contains(k) and app.contains(k)
+    assert users.unpack(k) == (42, "alice")
+    b, e = users.range()
+    assert b < k < e
+
+
+# -- directory ----------------------------------------------------------------
+
+
+def test_directory_layer():
+    sim, cluster, db = make_db()
+
+    async def body():
+        d = DirectoryLayer()
+
+        async def create(tr):
+            users = await d.create_or_open(tr, ("app", "users"))
+            tr.set(users.pack((1,)), b"alice")
+            return users.raw_prefix
+
+        prefix = await db.run(create)
+
+        async def reopen(tr):
+            users = await d.open(tr, ("app", "users"))
+            assert users.raw_prefix == prefix
+            return await tr.get(users.pack((1,)))
+
+        assert await db.run(reopen) == b"alice"
+
+        async def listing(tr):
+            return await d.list(tr, ("app",))
+
+        assert await db.run(listing) == ["users"]
+
+        async def second(tr):
+            other = await d.create_or_open(tr, ("app", "events"))
+            assert other.raw_prefix != prefix
+            return sorted(await d.list(tr, ("app",)))
+
+        assert await db.run(second) == ["events", "users"]
+
+        async def remove(tr):
+            await d.remove(tr, ("app", "users"))
+
+        await db.run(remove)
+
+        async def gone(tr):
+            return await d.exists(tr, ("app", "users"))
+
+        assert await db.run(gone) is False
+
+    drive(sim, body())
+
+
+# -- watches ------------------------------------------------------------------
+
+
+def test_watch_fires_on_change():
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def setup(tr):
+            tr.set(b"watched", b"v0")
+
+        await db.run(setup)
+
+        fired = db.watch(b"watched")
+        await delay(0.5)
+        assert not fired.is_ready()
+
+        async def change(tr):
+            tr.set(b"watched", b"v1")
+
+        await db.run(change)
+        new_value = await timeout(fired, 10.0, default="TIMEOUT")
+        assert new_value == b"v1"
+
+    drive(sim, body())
+
+
+def test_transaction_watch_after_commit():
+    sim, cluster, db = make_db()
+
+    async def body():
+        tr = db.transaction()
+        tr.set(b"k", b"a")
+        w = tr.watch(b"k")
+        await tr.commit()
+        await delay(0.5)
+        assert not w.is_ready()
+
+        async def change(tr2):
+            tr2.set(b"k", b"b")
+
+        await db.run(change)
+        assert await timeout(w, 10.0, default="TIMEOUT") == b"b"
+
+    drive(sim, body())
+
+
+def test_watch_on_clear_fires_with_none():
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def setup(tr):
+            tr.set(b"todel", b"x")
+
+        await db.run(setup)
+        w = db.watch(b"todel")
+
+        async def clear(tr):
+            tr.clear(b"todel")
+
+        await delay(0.2)
+        await db.run(clear)
+        assert await timeout(w, 10.0, default="TIMEOUT") is None
+
+    drive(sim, body())

@@ -1,0 +1,133 @@
+"""ManagementAPI + status + fdbcli analog: exclude/include, configure with
+forced recovery, status document, CLI command vocabulary."""
+
+from foundationdb_tpu.client import management
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.tools.cli import FdbCli
+
+
+def make(seed=0, n_coordinators=1, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(**cfg), n_coordinators=n_coordinators
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    return sim, cluster, db
+
+
+def run(sim, coro, limit=600.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+async def put(db, key, value):
+    async def body(tr):
+        tr.set(key, value)
+
+    await db.run(body)
+
+
+async def get(db, key):
+    async def body(tr):
+        return await tr.get(key)
+
+    return await db.run(body)
+
+
+def test_exclude_drains_server():
+    sim, cluster, db = make(
+        seed=51, n_proxies=1, n_resolvers=1, n_tlogs=2, n_storage=4,
+        replication=2, tlog_replication=2,
+    )
+
+    async def body():
+        for i in range(20):
+            await put(db, b"x%02d" % i, b"v%d" % i)
+        # find the worker address hosting storage tag 0
+        victim = next(
+            addr
+            for addr, p in sim.processes.items()
+            if getattr(p, "worker", None) and p.alive
+            for h in p.worker.roles.values()
+            if h.kind == "storage" and h.obj.tag == 0
+        )
+        await management.exclude_servers(db, [victim])
+        await management.wait_for_excluded(db, [victim])
+        assert victim in await management.get_excluded(db)
+        # all data still there
+        for i in range(20):
+            assert await get(db, b"x%02d" % i) == b"v%d" % i, i
+        await management.include_servers(db)
+        assert await management.get_excluded(db) == []
+
+    run(sim, body())
+
+
+def test_configure_changes_shape():
+    sim, cluster, db = make(
+        seed=52, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1,
+    )
+
+    async def body():
+        await put(db, b"a", b"1")
+        await management.configure(
+            db, cluster.coordinators, db.client, n_proxies=2, n_resolvers=2
+        )
+        # new generation must eventually serve with 2 proxies
+        deadline = sim.loop.now() + 60.0
+        while True:
+            await delay(1.0)
+            doc = await management.get_status(cluster.coordinators, db.client)
+            proxies = doc.get("client", {}).get("proxies", [])
+            if len(proxies) == 2:
+                break
+            assert sim.loop.now() < deadline, doc
+        assert await get(db, b"a") == b"1"
+        await put(db, b"b", b"2")
+        assert await get(db, b"b") == b"2"
+
+    run(sim, body())
+
+
+def test_status_document():
+    sim, cluster, db = make(
+        seed=53, n_proxies=2, n_resolvers=1, n_tlogs=2, n_storage=2,
+        replication=2, tlog_replication=2,
+    )
+
+    async def body():
+        await put(db, b"s", b"1")
+        doc = await management.get_status(cluster.coordinators, db.client)
+        c = doc["cluster"]
+        assert c["recovered"] is True
+        assert c["recovery_count"] >= 1
+        assert len(c["workers"]) >= 4
+        assert c["logs"]["epoch"] >= 1
+        assert len(doc["client"]["proxies"]) == 2
+
+    run(sim, body())
+
+
+def test_cli_vocabulary():
+    sim, cluster, db = make(
+        seed=54, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1,
+    )
+    cli = FdbCli(db, cluster.coordinators)
+
+    async def body():
+        assert await cli.execute("set hello world") == "Committed"
+        assert "`world'" in await cli.execute("get hello")
+        assert "not found" in await cli.execute("get missing")
+        await cli.execute("set hello2 there")
+        out = await cli.execute("getrange hello hellp 10")
+        assert "hello" in out and "hello2" in out
+        assert await cli.execute("clear hello") == "Committed"
+        assert "not found" in await cli.execute("get hello")
+        status = await cli.execute("status")
+        assert "Cluster controller" in status and "Recovered: True" in status
+        assert "unknown command" in await cli.execute("bogus")
+
+    run(sim, body())
