@@ -40,6 +40,10 @@ def _encode_one(v) -> bytes:
     if isinstance(v, str):
         return _encode_bytes_like(STRING, v.encode("utf-8"))
     if isinstance(v, int):
+        if not (-(1 << 64) < v < (1 << 64)):
+            # the reference errors on ints beyond 8 bytes; larger would
+            # emit typecodes outside 0x0c..0x1c and break ordering
+            raise ValueError("tuple layer integers are limited to 8 bytes")
         if v == 0:
             return bytes([INT_ZERO])
         if v > 0:

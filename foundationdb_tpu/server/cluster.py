@@ -155,11 +155,12 @@ class DynamicCluster:
         n_coordinators: int = 1,
         n_workers: int = None,
         knobs: Knobs = None,
+        prefix: str = "",  # distinct prefixes let several clusters share a sim
     ):
         self.sim = sim
         self.config = cfg = config or ClusterConfig()
         self.knobs = knobs or sim.knobs
-        self.coordinators = [f"coord{i}" for i in range(n_coordinators)]
+        self.coordinators = [f"{prefix}coord{i}" for i in range(n_coordinators)]
         for addr in self.coordinators:
             sim.new_process(addr, boot=_boot_coordinator)
 
@@ -182,7 +183,7 @@ class DynamicCluster:
         )
         self.worker_addrs = []
         for i, pclass in enumerate(classes):
-            addr = f"worker{i}"
+            addr = f"{prefix}worker{i}"
             self.worker_addrs.append(addr)
             sim.new_process(
                 addr,

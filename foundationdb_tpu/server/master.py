@@ -128,6 +128,7 @@ class DBCoreState:
     storage: tuple = ()  # tuple[StorageInterface]
     shards: tuple = ()  # tuple[(begin, end, addrs, tags)]
     config: dict = field(default_factory=dict)  # cluster shape knobs
+    log_ranges: dict = field(default_factory=dict)  # active backup captures
 
 
 class MasterTerminated(Exception):
@@ -207,9 +208,11 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     # txnStateStore recovery from the log system). Conf mutations in the
     # same stream update `config` — so this must run BEFORE the shape
     # counts below are read (configure → forced recovery → new shape).
+    log_ranges: dict = {}
     if prev:
         storage = list(prev.storage)
         shard_map = ShardMap.from_list(prev.shards)
+        log_ranges = dict(prev.log_ranges)
         from .systemdata import CONF_PREFIX
         from ..kv.mutations import MutationType
 
@@ -222,9 +225,12 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                 )
             except Exception:
                 continue
+            from .systemdata import apply_log_range_mutations
+
             for v, muts in reply.messages:
                 if v <= recovery_version:
                     apply_metadata_mutations(shard_map, muts)
+                    apply_log_range_mutations(log_ranges, muts)
                     for m in muts:
                         # configuration changes committed since the last
                         # recovery shape THIS one (configure → recovery)
@@ -326,6 +332,7 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                     shards=shard_map,
                     epoch=recovery_count,
                     recovery_version=recovery_version,
+                    log_ranges=log_ranges,
                 ),
             ),
         )
@@ -340,6 +347,7 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         storage=tuple(storage),
         shards=tuple(shards),
         config=config,
+        log_ranges=dict(log_ranges),
     )
     await cs.write(core)  # raises ClusterStateChanged if a successor fenced us
 
@@ -551,6 +559,7 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
         storage=core.storage,
         shards=core.shards,
         config=core.config,
+        log_ranges=core.log_ranges,
     )
     try:
         await cs.write(new_core)

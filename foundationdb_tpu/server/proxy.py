@@ -46,12 +46,31 @@ from ..runtime.futures import (
     wait_for_any,
 )
 from ..runtime.knobs import Knobs
+from ..runtime.serialize import BinaryWriter, write_mutation
 from .systemdata import (
     PRIVATE_PREFIX,
     TXS_TAG,
+    apply_log_range_mutations,
     apply_metadata_mutations,
     is_metadata_mutation,
 )
+
+
+def _clip_for_capture(m, cap):
+    """The portion of mutation `m` inside the capture range, or None.
+    Mutations already in the system/backup keyspace are never captured."""
+    if m.param1.startswith(b"\xff"):
+        return None
+    begin, end = cap["begin"], cap["end"]
+    if m.type == MutationType.CLEAR_RANGE:
+        b = max(m.param1, begin)
+        e = m.param2 if end is None else min(m.param2, end)
+        if b >= e:
+            return None
+        return Mutation(MutationType.CLEAR_RANGE, b, e)
+    if m.param1 >= begin and (end is None or m.param1 < end):
+        return m
+    return None
 from .interfaces import (
     CommitReply,
     CommitRequest,
@@ -125,6 +144,7 @@ class Proxy:
         epoch: int = 0,
         recovery_version: Version = 0,
         uid: str = "",
+        log_ranges: dict = None,  # uid → {begin, end, dest}: active captures
     ):
         self.master = master
         self.resolver_map = resolver_map
@@ -132,6 +152,7 @@ class Proxy:
         if isinstance(shards, ShardMap):
             shards = shards.to_list()
         self.shards = ShardMap.from_list(shards)  # own copy: mutated by echoes
+        self.log_ranges = dict(log_ranges or {})
         self.knobs = knobs or Knobs()
         self.epoch = epoch
         self.uid = uid
@@ -305,6 +326,7 @@ class Proxy:
             plan = self._apply_state_mutations(resolutions, version)
             to_log: dict[int, list[Mutation]] = {}
             stamps: list[bytes] = []
+            log_counter = 0  # per-batch ordinal for backup-log keys
             for idx, (txn, verdict) in enumerate(zip(txns, verdicts)):
                 stamp = make_versionstamp(version, idx)
                 stamps.append(stamp)
@@ -321,6 +343,25 @@ class Proxy:
                         # every metadata mutation also rides the txs tag
                         # (the recovering master's shard-map delta stream)
                         to_log.setdefault(TXS_TAG, []).append(m)
+                    # active mutation-log captures (backup/DR): duplicate
+                    # the mutation into the backup-log keyspace (the
+                    # \xff\x02 machinery — MasterProxyServer's
+                    # vecBackupKeys handling in commitBatch phase 3)
+                    for cap in self.log_ranges.values():
+                        dup = _clip_for_capture(m, cap)
+                        if dup is None:
+                            continue
+                        log_key = cap["dest"] + struct.pack(
+                            ">QI", version, log_counter
+                        )
+                        log_counter += 1
+                        w = BinaryWriter()
+                        write_mutation(w, dup)
+                        copy = Mutation(
+                            MutationType.SET_VALUE, log_key, w.data()
+                        )
+                        for tag in self.shards.tags_for_key(log_key):
+                            to_log.setdefault(tag, []).append(copy)
             # privatized copies: shard-assignment changes delivered through
             # the affected storage servers' own streams
             for m, private_tags in plan:
@@ -424,10 +465,11 @@ class Proxy:
 
     def _apply_state_mutations(self, resolutions, version):
         """Apply every forwarded state txn (from any proxy) committed at a
-        version ≤ this batch's to our shard map, in version order; a state
-        txn counts committed iff EVERY resolver's echo says so
-        (commitBatch :432-450). Returns the privatization plan for state
-        txns of THIS batch (only the committing proxy pushes them)."""
+        version ≤ this batch's to our shard map (and the active capture
+        set), in version order; a state txn counts committed iff EVERY
+        resolver's echo says so (commitBatch :432-450). Returns the
+        privatization plan for state txns of THIS batch (only the
+        committing proxy pushes them)."""
         r0 = resolutions[0]
         plan = []
         for vi, (v, entries) in enumerate(r0.state_mutations):
@@ -437,9 +479,13 @@ class Proxy:
                 if not committed:
                     continue
                 applied = apply_metadata_mutations(self.shards, muts)
+                self._apply_log_range_mutations(muts)
                 if v == version:
                     plan.extend(applied)
         return plan
+
+    def _apply_log_range_mutations(self, muts) -> None:
+        apply_log_range_mutations(self.log_ranges, muts)
 
     # -- wiring ----------------------------------------------------------------
 

@@ -28,8 +28,38 @@ PRIVATE_PREFIX = b"\xff\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 SERVER_LIST_PREFIX = b"\xff/serverList/"
 CONF_PREFIX = b"\xff/conf/"
+# active mutation-log captures (backup/DR): \xff/logRanges/<uid> →
+# {begin, end, dest} — the reference's logRangesRange
+# (SystemData.cpp logRangesRange + ApplyMetadataMutation's handling);
+# committed mutations inside [begin, end) are duplicated by the proxies
+# into the dest prefix (the \xff\x02 backup log keyspace)
+LOG_RANGES_PREFIX = b"\xff/logRanges/"
+BACKUP_LOG_PREFIX = b"\xff\x02/blog/"
 
 TXS_TAG = -1  # the txnStateStore tag, on every tlog
+
+
+def log_ranges_key(uid: str) -> bytes:
+    return LOG_RANGES_PREFIX + uid.encode()
+
+
+def log_ranges_value(begin: bytes, end, dest: bytes) -> bytes:
+    return json.dumps(
+        {
+            "begin": begin.hex(),
+            "end": end.hex() if end is not None else "inf",
+            "dest": dest.hex(),
+        }
+    ).encode()
+
+
+def decode_log_ranges_value(value: bytes) -> dict:
+    d = json.loads(value.decode())
+    return {
+        "begin": bytes.fromhex(d["begin"]),
+        "end": None if d["end"] == "inf" else bytes.fromhex(d["end"]),
+        "dest": bytes.fromhex(d["dest"]),
+    }
 
 
 def key_servers_key(begin: bytes) -> bytes:
@@ -69,11 +99,38 @@ def decode_key_servers_value(value: bytes) -> dict:
     }
 
 
+def apply_log_range_mutations(log_ranges: dict, mutations) -> None:
+    """Track backup/DR capture registrations (\\xff/logRanges/) from a
+    committed metadata-mutation stream into `log_ranges` (uid → decoded
+    value). Shared by the proxies' live state application and the master's
+    recovery replay — one format, one interpreter."""
+    from ..kv.mutations import MutationType
+
+    for m in mutations:
+        if m.type == MutationType.SET_VALUE and m.param1.startswith(
+            LOG_RANGES_PREFIX
+        ):
+            uid = m.param1[len(LOG_RANGES_PREFIX) :].decode()
+            log_ranges[uid] = decode_log_ranges_value(m.param2)
+        elif m.type == MutationType.CLEAR_RANGE:
+            for uid in [
+                u
+                for u in log_ranges
+                if m.param1 <= LOG_RANGES_PREFIX + u.encode() < m.param2
+            ]:
+                del log_ranges[uid]
+
+
 def is_metadata_mutation(m) -> bool:
-    """Does this mutation touch the system keyspace? (the proxy's
-    isMetadataMutation test in ResolutionRequestBuilder)."""
-    return m.param1.startswith(SYSTEM_PREFIX) and not m.param1.startswith(
-        PRIVATE_PREFIX
+    """Does this mutation touch the transaction-state keyspace? (the
+    proxy's isMetadataMutation test in ResolutionRequestBuilder). The
+    backup-log keyspace (\\xff\\x02) is system-prefixed but NOT state —
+    it's bulk data the agents drain; forwarding it through the resolvers
+    and the txs tag would pin the tlogs with it."""
+    return (
+        m.param1.startswith(SYSTEM_PREFIX)
+        and not m.param1.startswith(PRIVATE_PREFIX)
+        and not m.param1.startswith(b"\xff\x02")
     )
 
 

@@ -321,6 +321,7 @@ class Worker:
         shards=None,
         epoch=0,
         recovery_version=0,
+        log_ranges=None,
     ):
         from .proxy import Proxy
 
@@ -333,6 +334,7 @@ class Worker:
             epoch=epoch,
             recovery_version=recovery_version,
             uid=h.uid,
+            log_ranges=log_ranges,
         )
         h.epoch, h.obj = epoch, pr
         pr.register_instance(self.process)

@@ -126,6 +126,42 @@ class FdbCli:
         await management.include_servers(self.db, list(args) or None)
         return "Included"
 
+    # -- backup (the fdbbackup personalities, fdbbackup/backup.actor.cpp) ------
+
+    async def _cmd_backup(self, args) -> str:
+        """backup start <container> | backup discontinue"""
+        from ..backup import BackupAgent, BackupContainer
+
+        sub = args[0]
+        if sub == "start":
+            name = args[1] if len(args) > 1 else "backup"
+            container = BackupContainer(
+                self.db.sim.disk("backup-store"), name
+            )
+            agent = BackupAgent(self.db, container, uid=name)
+            await agent.submit()
+            await agent.wait_snapshot_complete()
+            self._backup_agents = getattr(self, "_backup_agents", {})
+            self._backup_agents[name] = agent
+            return f"The backup on tag `{name}' was successfully submitted"
+        if sub == "discontinue":
+            name = args[1] if len(args) > 1 else "backup"
+            agent = getattr(self, "_backup_agents", {}).get(name)
+            if agent is None:
+                return f"ERROR: no running backup `{name}'"
+            await agent.discontinue()
+            return f"The backup on tag `{name}' was successfully discontinued"
+        return "ERROR: backup start|discontinue"
+
+    async def _cmd_restore(self, args) -> str:
+        from ..backup import BackupContainer
+        from ..backup.agent import restore
+
+        name = args[0] if args else "backup"
+        container = BackupContainer(self.db.sim.disk("backup-store"), name)
+        n = await restore(self.db, container)
+        return f"Restored {n} snapshot rows (+ mutation log)"
+
     async def _cmd_configure(self, args) -> str:
         changes = {}
         for a in args:
