@@ -58,7 +58,64 @@ def make_batches(n_batches, n_txns, seed=0):
     return batches
 
 
+def bench_range_index():
+    """BENCH_COMPONENT=range_index: the storage read path's batched lookup
+    primitive vs the host-side bisect loop (SURVEY.md secondary target)."""
+    import bisect
+
+    import numpy as np
+
+    from foundationdb_tpu.ops.range_index import TpuRangeIndex
+
+    n_keys = int(os.environ.get("BENCH_INDEX_KEYS", "1000000"))
+    batch = int(os.environ.get("BENCH_INDEX_BATCH", "4096"))
+    rounds = int(os.environ.get("BENCH_INDEX_ROUNDS", "50"))
+    rnd = random.Random(0)
+    keys = sorted({b"%012d" % rnd.randrange(10**12) for _ in range(n_keys)})
+    log(f"building index over {len(keys)} keys")
+    idx = TpuRangeIndex(keys)
+    queries = [
+        [rnd.choice(keys) if rnd.random() < 0.7 else b"%012d" % rnd.randrange(10**12)
+         for _ in range(batch)]
+        for _ in range(rounds)
+    ]
+    # warm the kernel
+    idx.batch_lookup(queries[0])
+    t0 = time.time()
+    hits = 0
+    for q in queries:
+        _rows, found = idx.batch_lookup(q)
+        hits += int(found.sum())
+    tpu_dt = time.time() - t0
+    tpu_qps = rounds * batch / tpu_dt
+    log(f"tpu index: {tpu_dt:.2f}s, {tpu_qps/1e6:.3f} M lookups/s, {hits} hits")
+    t0 = time.time()
+    host_hits = 0
+    for q in queries:
+        for k in q:
+            i = bisect.bisect_left(keys, k)
+            if i < len(keys) and keys[i] == k:
+                host_hits += 1
+    host_dt = time.time() - t0
+    host_qps = rounds * batch / host_dt
+    log(f"host bisect: {host_dt:.2f}s, {host_qps/1e6:.3f} M lookups/s")
+    assert hits == host_hits, (hits, host_hits)
+    print(
+        json.dumps(
+            {
+                "metric": "storage_batched_lookup_throughput",
+                "value": round(tpu_qps, 1),
+                "unit": "lookups/s",
+                "vs_baseline": round(tpu_qps / host_qps, 3),
+            }
+        )
+    )
+
+
 def main():
+    if os.environ.get("BENCH_COMPONENT") == "range_index":
+        bench_range_index()
+        return
     from foundationdb_tpu.conflict.native import NativeConflictSet
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
 

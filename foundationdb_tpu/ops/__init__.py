@@ -1,0 +1,3 @@
+"""TPU-accelerated data-plane primitives (JAX/XLA kernels)."""
+
+from .range_index import TpuRangeIndex  # noqa: F401

@@ -1,0 +1,110 @@
+"""Batched interval/point queries over a sorted key snapshot — the storage
+read path's XLA primitive.
+
+SURVEY.md's secondary north-star: the reference answers every storage read
+with pointer-chasing walks of per-node structures (the PTree of
+fdbclient/VersionedMap.h on the MVCC window; sqlite's btree below it,
+KeyRangeMap:36 for shard routing). A TPU can't chase pointers, but it can
+answer THOUSANDS of lookups in one fused kernel: keys become fixed-width
+order-preserving lane codes (conflict/keys.py — the same encoding the
+conflict kernel uses), the snapshot is one lex-sorted [N, L] device array,
+and a batch of point/range queries is a vectorized binary search
+(O(log N) gathers for the whole batch) on the MXU-fed VPU.
+
+Used by StorageServer.batch_get (many point reads in one call) and usable
+for shard-map style interval routing; bench mode BENCH_COMPONENT=range_index
+measures it against the host-side bisect loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..conflict import keys as K
+
+
+class TpuRangeIndex:
+    """An immutable snapshot index over sorted keys.
+
+    build once per durability epoch (keys change only when the durable
+    engine advances), query many times in batches."""
+
+    def __init__(self, keys: list, width: int = 32, backend=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self.width = width
+        self.n = len(keys)
+        codes = K.encode_keys(list(keys), width=width)  # already lane-packed
+        # pad to a power of two with the max sentinel so searchsorted
+        # stays in-bounds with static shapes
+        cap = 1
+        while cap < max(self.n, 1):
+            cap <<= 1
+        pad = np.tile(K.max_sentinel(width), (cap - self.n, 1))
+        self._codes = jnp.asarray(
+            np.concatenate([codes, pad], axis=0) if cap > self.n else codes
+        )
+        self._lookup_jit = {}
+
+    # -- queries ---------------------------------------------------------------
+
+    def _encode_queries(self, qkeys: list) -> np.ndarray:
+        return K.encode_keys(list(qkeys), width=self.width)
+
+    def _fn_for(self, qshape: int):
+        fn = self._lookup_jit.get(qshape)
+        if fn is None:
+            from ..conflict.tpu_index import _searchsorted
+
+            jax = self._jax
+
+            def kernel(codes, q):
+                lo = _searchsorted(codes, q, side="left")
+                hi = _searchsorted(codes, q, side="right")
+                return lo, hi
+
+            fn = self._lookup_jit[qshape] = jax.jit(kernel)
+        return fn
+
+    def batch_lookup(self, qkeys: list):
+        """(indices, found): for each query key, its row in the snapshot
+        (or -1). One kernel launch for the whole batch."""
+        if self.n == 0 or not qkeys:
+            return np.full(len(qkeys), -1, np.int64), np.zeros(len(qkeys), bool)
+        q = self._pad_queries(self._encode_queries(qkeys))
+        lo, hi = self._fn_for(q.shape[0])(self._codes, self._jnp.asarray(q))
+        lo = np.asarray(lo)[: len(qkeys)]
+        hi = np.asarray(hi)[: len(qkeys)]
+        found = (hi > lo) & (lo < self.n)
+        return np.where(found, lo, -1), found
+
+    def batch_range(self, begins: list, ends: list):
+        """[(lo, hi)) row bounds per (begin, end) interval — the batched
+        KeyRangeMap/readRange primitive."""
+        if self.n == 0 or not begins:
+            z = np.zeros(len(begins), np.int64)
+            return z, z
+        nq = len(begins)
+        qb = self._pad_queries(self._encode_queries(begins))
+        qe = self._pad_queries(self._encode_queries(ends))
+        fn = self._fn_for(qb.shape[0])
+        lo, _ = fn(self._codes, self._jnp.asarray(qb))
+        hi, _ = fn(self._codes, self._jnp.asarray(qe))
+        return (
+            np.minimum(np.asarray(lo)[:nq], self.n),
+            np.minimum(np.asarray(hi)[:nq], self.n),
+        )
+
+    def _pad_queries(self, q: np.ndarray) -> np.ndarray:
+        """Pad the batch to a power of two: stable jit cache keys."""
+        n = q.shape[0]
+        cap = 1
+        while cap < n:
+            cap <<= 1
+        if cap == n:
+            return q
+        pad = np.tile(K.max_sentinel(self.width), (cap - n, 1))
+        return np.concatenate([q, pad], axis=0)

@@ -22,6 +22,7 @@ class Knobs:
     # storage
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_FETCH_KEYS_BATCH = 10_000
+    STORAGE_TPU_INDEX = False  # TPU batched-read snapshot index
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
     # failure detection / recovery

@@ -384,6 +384,7 @@ class Tokens:
     GET_KEY_VALUES = "storage.getKeyValues"
     GET_SHARD_STATE = "storage.getShardState"
     WATCH_VALUE = "storage.watchValue"
+    BATCH_GET = "storage.batchGet"
     # worker
     WORKER_RECRUIT = "worker.recruit"
     WORKER_SET_DB_INFO = "worker.setDBInfo"

@@ -76,6 +76,9 @@ class StorageServer:
         # range → None | ("owned", rv) | ("adding", mv, sources) as of the
         # durable version — what reboot recovery restores
         self._persist_owned = KeyRangeMap(default=None)
+        # TPU batched-read snapshot index (rebuilt per durability advance
+        # when the knob is on; conflict-kernel key encoding)
+        self._range_index = None
         # shard ownership: range → None (not ours) | ("owned", ready_version)
         # | ("adding", since_version) — the reference's shards map with
         # AddingShard state (storageserver.actor.cpp:1761 fetchKeys)
@@ -469,6 +472,10 @@ class StorageServer:
         del q[:i]
         self.engine.set(b"\xff\xff/local/meta", self._encode_local_meta(new_durable))
         await self.engine.commit()
+        if getattr(self.knobs, "STORAGE_TPU_INDEX", False):
+            from ..ops.range_index import TpuRangeIndex
+
+            self._range_index = TpuRangeIndex(list(self.engine._keys))
 
     def _encode_local_meta(self, durable: Version) -> bytes:
         import json
@@ -602,6 +609,34 @@ class StorageServer:
                 return rows[:limit]
             want *= 2
 
+    async def batch_get(self, req):
+        """Many point reads in ONE request: window hits answer locally;
+        engine misses resolve through the TPU range-index snapshot in one
+        vectorized lookup (SURVEY.md's batched read-path primitive).
+        req = (keys, version) → [value | None]."""
+        keys, version = req
+        await self._wait_for_version(version)
+        out = [None] * len(keys)
+        misses, miss_idx = [], []
+        for i, k in enumerate(keys):
+            self._check_read(k, k + b"\x00", version)
+            known, v = self.data.get_with_presence(k, version)
+            if known:
+                out[i] = v
+            elif self.engine is not None:
+                misses.append(k)
+                miss_idx.append(i)
+        if misses:
+            if self._range_index is not None:
+                _idx, found = self._range_index.batch_lookup(misses)
+                for j, i in enumerate(miss_idx):
+                    if found[j]:
+                        out[i] = self.engine._map.get(misses[j])
+            else:
+                for j, i in enumerate(miss_idx):
+                    out[i] = self.engine.read_value(misses[j])
+        return out
+
     async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:
         """Park until the key's value differs from the watcher's belief
         (watchValue_impl:758). Fires on the version that changed it. The
@@ -646,6 +681,7 @@ class StorageServer:
         process.register(f"storage.ping#{self.uid}", self._ping)
         process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
         process.register(Tokens.WATCH_VALUE, self.watch_value)
+        process.register(Tokens.BATCH_GET, self.batch_get)
         trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
 
     def register(self, process) -> None:
