@@ -63,15 +63,18 @@ class SimFile:
         self.sim = sim
         self.path = path
         self._durable = bytearray()
-        # unsynced writes: [(offset, bytes)] in application order
-        self._pending: list[tuple[int, bytes]] = []
-        self._pending_truncate = None
+        # unsynced ops in ISSUE ORDER: ("write", offset, bytes) |
+        # ("trunc", size). One ordered list, replayed in sequence, so a
+        # truncate never retroactively clips a write issued after it and a
+        # write issued before a truncate never resurrects bytes beyond it —
+        # orderings a real disk can't produce.
+        self._pending_ops: list[tuple] = []
 
     # -- IAsyncFile ------------------------------------------------------------
 
     async def write(self, offset: int, data: bytes) -> None:
         await delay(self.WRITE_TIME)
-        self._pending.append((offset, bytes(data)))
+        self._pending_ops.append(("write", offset, bytes(data)))
 
     async def read(self, offset: int, length: int) -> bytes:
         await delay(self.WRITE_TIME)
@@ -81,13 +84,11 @@ class SimFile:
     async def sync(self) -> None:
         await delay(self.SYNC_TIME)
         self._durable = self._image()
-        self._pending = []
-        self._pending_truncate = None
+        self._pending_ops = []
 
     async def truncate(self, size: int) -> None:
         await delay(self.WRITE_TIME)
-        self._pending_truncate = size
-        self._pending = [(o, d) for o, d in self._pending if o < size]
+        self._pending_ops.append(("trunc", size))
 
     def size(self) -> int:
         return len(self._image())
@@ -96,27 +97,23 @@ class SimFile:
 
     def _image(self) -> bytearray:
         img = bytearray(self._durable)
-        if self._pending_truncate is not None:
-            del img[self._pending_truncate :]
-        for offset, data in self._pending:
-            if len(img) < offset:
-                img.extend(b"\x00" * (offset - len(img)))
-            img[offset : offset + len(data)] = data
+        for op in self._pending_ops:
+            if op[0] == "write":
+                _, offset, data = op
+                if len(img) < offset:
+                    img.extend(b"\x00" * (offset - len(img)))
+                img[offset : offset + len(data)] = data
+            else:
+                del img[op[1] :]
         return img
 
     def lose_unsynced(self, rng) -> None:
-        """Kill semantics: each unsynced write independently may or may
-        not have hit the disk (the nondurable file's page-wise coinflip)."""
-        survivors = [w for w in self._pending if rng.coinflip(0.5)]
-        keep_truncate = (
-            self._pending_truncate is not None and rng.coinflip(0.5)
-        )
-        self._pending = survivors
-        if self._pending_truncate is not None and not keep_truncate:
-            self._pending_truncate = None
+        """Kill semantics: each unsynced op independently may or may not
+        have hit the disk (the nondurable file's page-wise coinflip),
+        replayed in issue order so surviving ops keep their sequencing."""
+        self._pending_ops = [op for op in self._pending_ops if rng.coinflip(0.5)]
         self._durable = self._image()
-        self._pending = []
-        self._pending_truncate = None
+        self._pending_ops = []
 
 
 class RealDisk:

@@ -27,16 +27,19 @@ from ..net.sim import Endpoint
 from ..runtime.futures import delay, timeout
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import GetKeyServersRequest, Tokens
-from .movekeys import move_shard
+from .movekeys import move_shard, take_move_keys_lock
 
 
 class DataDistributor:
-    def __init__(self, process, db, storage, knobs, replication: int):
+    def __init__(self, process, db, storage, knobs, replication: int, uid: str = ""):
         self.process = process
         self.db = db  # Database over this epoch's proxies
         self.storage = list(storage)  # [StorageInterface]
         self.knobs = knobs
         self.replication = replication
+        # moveKeysLock owner id: this DD's claim on shard relocation;
+        # a successor DD overwrites it and our movers abort (movekeys.py)
+        self.uid = uid or f"dd-{process.address}"
         self.alive: dict[int, bool] = {s.tag: True for s in storage}
         # (shard begin, tag) → consecutive rounds a live member reported
         # the shard unreadable (e.g. it rebooted and lost an in-flight
@@ -46,6 +49,7 @@ class DataDistributor:
     async def run(self):
         monitor = self.process.spawn(self._failure_monitor())
         try:
+            await take_move_keys_lock(self.db, self.uid)
             while True:
                 await delay(1.0)
                 try:
@@ -190,7 +194,13 @@ class DataDistributor:
                 From=tags,
                 To=tuple(new_tags),
             )
-            await move_shard(self.db, begin, end, [by_tag[t] for t in new_tags])
+            await move_shard(
+                self.db,
+                begin,
+                end,
+                [by_tag[t] for t in new_tags],
+                lock_owner=self.uid,
+            )
             for t in candidates[:need]:
                 load[t] += 1
 

@@ -235,9 +235,22 @@ class PeekCursor:
             msgs, end = reply.messages, reply.end_version
             if clamp is not None:
                 msgs = [(v, ms) for v, ms in msgs if v <= clamp]
-                # the old generation is complete through its end version —
-                # advance past it even if this tlog's durable stopped short
-                end = clamp
+                if end >= clamp:
+                    # this replica is durable through the generation's end —
+                    # the whole old generation is consumed; advance past it
+                    end = clamp
+                else:
+                    # The replica's durable version stops short of the
+                    # recovery-retained end: versions (end, clamp] may exist
+                    # on another replica (lock only guarantees >= 1 locked
+                    # replica per tag), so advancing to clamp here would
+                    # silently skip them (the reference's merge-cursor /
+                    # known-committed handling). Advance only to what this
+                    # replica proved; if that is no progress, fail over.
+                    if end <= begin and not msgs:
+                        self._replica += 1
+                        await delay(0.05)
+                        continue
             return msgs, end
 
     async def pop(self, upto: int) -> None:

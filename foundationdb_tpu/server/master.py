@@ -351,6 +351,23 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     )
     await cs.write(core)  # raises ClusterStateChanged if a successor fenced us
 
+    # The cstate snapshot now subsumes the old generations' txs streams
+    # (shards/config were rebuilt from them above), so release them: pop
+    # TXS_TAG on every old tlog — the analog of the reference popping the
+    # txnStateStore tag once the recovered state is durably coordinated.
+    # Best-effort: a dead old tlog's txs data dies with it anyway.
+    from .interfaces import TLogPopRequest
+
+    for old in old_sets:
+        for log in old.set.logs:
+            process.spawn(
+                _pop_quietly(
+                    process,
+                    log.ep("pop"),
+                    TLogPopRequest(tag=TXS_TAG, upto=recovery_version),
+                )
+            )
+
     # FULLY_RECOVERED: publish
     info = ServerDBInfo(
         id=recovery_count * 1000,
@@ -386,7 +403,12 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         process.sim, client_addr=process.address, proxy_ifaces=list(proxy_ifaces)
     )
     dd = DataDistributor(
-        process, dd_db, storage, knobs, int(config.get("replication", 1))
+        process,
+        dd_db,
+        storage,
+        knobs,
+        int(config.get("replication", 1)),
+        uid=f"dd-{uid}-{recovery_count}",
     )
     rk = Ratekeeper(process, master, storage, knobs, uid)
     watched = (
@@ -446,6 +468,13 @@ class _RolePicker:
             chosen.append(w)
             self.load[w.address] += 1
         return chosen
+
+
+async def _pop_quietly(process, ep, req):
+    try:
+        await process.request(ep, req)
+    except Exception:
+        pass  # popping a dead tlog is moot
 
 
 def _split_points(n: int) -> list[bytes]:

@@ -23,11 +23,39 @@ from __future__ import annotations
 from ..net.sim import Endpoint
 from ..runtime.futures import delay
 from .interfaces import GetKeyServersRequest, Tokens
-from .systemdata import key_servers_key, key_servers_value
+from .systemdata import (
+    MOVE_KEYS_LOCK_KEY,
+    decode_key_servers_value,
+    key_servers_key,
+    key_servers_value,
+)
 
 
 class MoveKeysError(Exception):
     pass
+
+
+async def take_move_keys_lock(db, owner: str) -> None:
+    """Claim shard-relocation ownership (takeMoveKeysLock in the
+    reference's MoveKeys.actor.cpp): the new DD overwrites the lock, and
+    any mover still holding the old owner id fails its next transaction."""
+
+    async def body(tr):
+        tr.set(MOVE_KEYS_LOCK_KEY, owner.encode())
+
+    await db.run(body)
+
+
+async def _check_move_keys_lock(tr, lock_owner) -> None:
+    """Read (⇒ conflict-range) the lock inside a mover transaction; a
+    mismatch means another DD took over — abort the move."""
+    if lock_owner is None:
+        return
+    cur = await tr.get(MOVE_KEYS_LOCK_KEY)
+    if cur is None or cur.decode() != lock_owner:
+        raise MoveKeysError(
+            f"moveKeysLock stolen: held by {cur!r}, we are {lock_owner!r}"
+        )
 
 
 async def move_shard(
@@ -37,13 +65,21 @@ async def move_shard(
     dest,
     poll_interval: float = 0.2,
     ready_timeout: float = 60.0,
+    lock_owner: str = None,
 ):
     """Move [begin, end) to the team ``dest`` ([StorageInterface]).
     The range must lie inside one current shard (DD moves shard by shard).
     Returns when the move is complete and sources have been released.
     Raises MoveKeysError if a destination never becomes ready (e.g. it
     died mid-move) — the caller (DD) re-plans with a healthy team; the
-    union-team start state stays safe to re-move."""
+    union-team start state stays safe to re-move.
+
+    Both phases read the keyServers row and the moveKeysLock inside their
+    transactions (gaining read-conflict ranges), so concurrent movers —
+    e.g. an old master's DD racing the new one during a fencing window —
+    conflict and abort instead of interleaving start/finish writes
+    (the reference's moveKeysLock + in-transaction reads,
+    MoveKeys.actor.cpp startMoveKeys/finishMoveKeys)."""
     reply = await db._proxy_request(
         Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=begin)
     )
@@ -64,6 +100,17 @@ async def move_shard(
 
     # phase 1: startMoveKeys — destinations begin fetching
     async def start(tr):
+        await _check_move_keys_lock(tr, lock_owner)
+        cur = await tr.get(key_servers_key(begin))
+        cur_tags = (
+            decode_key_servers_value(cur)["tags"] if cur is not None else None
+        )
+        if cur_tags is not None and set(cur_tags) == set(union_tags):
+            return  # our start already committed (retry after unknown result)
+        if cur_tags is not None and set(cur_tags) != set(src_tags):
+            raise MoveKeysError(
+                f"shard {begin!r} changed under us: {cur_tags} != {src_tags}"
+            )
         tr.set(
             key_servers_key(begin),
             key_servers_value(
@@ -98,6 +145,17 @@ async def move_shard(
 
     # phase 2: finishMoveKeys — sources release the range
     async def finish(tr):
+        await _check_move_keys_lock(tr, lock_owner)
+        cur = await tr.get(key_servers_key(begin))
+        cur_tags = (
+            decode_key_servers_value(cur)["tags"] if cur is not None else None
+        )
+        if cur_tags is not None and set(cur_tags) == set(dest_tags):
+            return  # our finish already committed
+        if cur_tags is not None and set(cur_tags) != set(union_tags):
+            raise MoveKeysError(
+                f"shard {begin!r} changed mid-move: {cur_tags} != {union_tags}"
+            )
         tr.set(
             key_servers_key(begin),
             key_servers_value(

@@ -38,6 +38,13 @@ BACKUP_LOG_PREFIX = b"\xff\x02/blog/"
 
 TXS_TAG = -1  # the txnStateStore tag, on every tlog
 
+# Ownership fence for shard relocation (the reference's moveKeysLockOwnerKey,
+# SystemData.cpp): the current DD instance writes its uid here; every
+# start/finish transaction re-reads it, so a superseded DD (an old master's,
+# still running during a fencing window) conflicts instead of corrupting the
+# keyServers bookkeeping mid-move.
+MOVE_KEYS_LOCK_KEY = b"\xff/moveKeysLock"
+
 
 def log_ranges_key(uid: str) -> bytes:
     return LOG_RANGES_PREFIX + uid.encode()

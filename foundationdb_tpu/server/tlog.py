@@ -73,6 +73,12 @@ class TLog:
         # [(version, start_offset, end_offset)]
         self._dq_index: list[tuple[Version, int, int]] = []
         self._pops_since_compact = 0
+        # Serializes the DiskQueue pop/compact section: compaction rewrites
+        # file offsets across suspension points, so a concurrent pop using
+        # pre-compaction _dq_index offsets would persist a bogus popped
+        # frontier (data loss at replication=1 after reboot).
+        self._pop_busy = False
+        self._pop_waiters: list[Future] = []
 
     async def recover(self) -> None:
         """Rebuild from the DiskQueue after a reboot
@@ -194,56 +200,99 @@ class TLog:
         prev = self._popped.get(req.tag, 0)
         if req.upto > prev:
             self._popped[req.tag] = req.upto
-            horizon = self._trim()
-            if self.dq is not None and horizon is not None:
-                j = bisect.bisect_right(
-                    [v for v, _o, _e in self._dq_index], horizon
-                )
-                if j:
-                    # pop to the start of the first retained entry, or the
-                    # END of the last one when everything is retired (a
-                    # mid-entry frontier would make the compacted file
-                    # start with a torn fragment and recovery would
-                    # discard everything after it)
-                    if j < len(self._dq_index):
-                        self.dq.pop(self._dq_index[j][1])
-                    else:
-                        self.dq.pop(self._dq_index[-1][2])
-                    del self._dq_index[:j]
-                    self._pops_since_compact += 1
-                    # compact only with no commit in flight: compaction
-                    # rewrites offsets and must not interleave with pushes
-                    if (
-                        self._pops_since_compact >= 64
-                        and not self.stopped
-                        and not self._pending
-                    ):
-                        self._pops_since_compact = 0
-                        await self.dq.commit()
-                        if not self._pending:
-                            shift = await self.dq.compact()
-                            if shift:
-                                self._dq_index = [
-                                    (v, o - shift, e - shift)
-                                    for v, o, e in self._dq_index
-                                ]
+            # the dq pop/compact section below suspends (commit/compact
+            # awaits); serialize concurrent pop handlers through it so no
+            # one calls dq.pop with offsets from a stale _dq_index
+            while self._pop_busy:
+                w = Future()
+                self._pop_waiters.append(w)
+                await w
+            self._pop_busy = True
+            try:
+                horizon = self._trim()
+                if self.dq is not None and horizon is not None:
+                    j = bisect.bisect_right(
+                        [v for v, _o, _e in self._dq_index], horizon
+                    )
+                    if j:
+                        # pop to the start of the first retained entry, or
+                        # the END of the last one when everything is retired
+                        # (a mid-entry frontier would make the compacted
+                        # file start with a torn fragment and recovery
+                        # would discard everything after it)
+                        if j < len(self._dq_index):
+                            self.dq.pop(self._dq_index[j][1])
+                        else:
+                            self.dq.pop(self._dq_index[-1][2])
+                        del self._dq_index[:j]
+                        self._pops_since_compact += 1
+                        # compact only with no commit in flight: compaction
+                        # rewrites offsets and must not interleave with
+                        # pushes
+                        if (
+                            self._pops_since_compact >= 64
+                            and not self.stopped
+                            and not self._pending
+                        ):
+                            self._pops_since_compact = 0
+                            await self.dq.commit()
+                            if not self._pending:
+                                # entries appended while compact() is in
+                                # flight already use new-file coordinates
+                                # (a push during its copy phase aborts the
+                                # compaction instead) — rebase only the
+                                # entries that existed before the call
+                                pre = len(self._dq_index)
+                                shift = await self.dq.compact()
+                                if shift:
+                                    self._dq_index[:pre] = [
+                                        (v, o - shift, e - shift)
+                                        for v, o, e in self._dq_index[:pre]
+                                    ]
+            finally:
+                self._pop_busy = False
+                if self._pop_waiters:
+                    self._pop_waiters.pop(0)._set(None)
         return None
 
     def _trim(self):
         """Drop log entries every tag has popped past (reference: DiskQueue
         pop location advancing once all tags acknowledge). Returns the
-        trim horizon (or None)."""
+        DiskQueue-safe trim horizon (or None).
+
+        TXS_TAG is excluded from the horizon min: the txs stream is popped
+        only by a recovering master (after the shard-map snapshot lands in
+        the coordinated state), so including it would pin EVERY tag's data
+        for the whole epoch the moment one metadata mutation is logged.
+        Entries at/below the horizon that still carry unpopped txs data are
+        retained txs-only (other tags' payloads stripped) — the reference's
+        separate txnStateStore retention via LogSystemDiskQueueAdapter."""
         if not self._log:
             return None
-        # a tag with data but no pop record pins the log
+        # a (non-txs) tag with data but no pop record pins the log
         live_tags = set()
         for _, msgs in self._log:
             live_tags.update(msgs)
-        horizon = min((self._popped.get(t, 0) for t in live_tags), default=0)
-        i = bisect.bisect_right(self._versions, horizon)
-        if i:
-            del self._log[:i]
-            del self._versions[:i]
+        live_tags.discard(TXS_TAG)
+        if live_tags:
+            horizon = min(self._popped.get(t, 0) for t in live_tags)
+        else:
+            horizon = self.version.get()  # only txs data remains
+        txs_popped = self._popped.get(TXS_TAG, 0)
+        if self._versions[0] > horizon:
+            return horizon  # nothing at/below the horizon: no-op pop
+        new_log = []
+        for v, msgs in self._log:
+            if v > horizon:
+                new_log.append((v, msgs))
+            elif TXS_TAG in msgs and v > txs_popped:
+                new_log.append((v, {TXS_TAG: msgs[TXS_TAG]}))
+        self._log = new_log
+        self._versions = [v for v, _ in new_log]
+        # the DiskQueue frontier must stop short of the first retained
+        # entry (pops are prefix-contiguous)
+        if self._versions and self._versions[0] <= horizon:
+            return self._versions[0] - 1
         return horizon
 
     def register_instance(self, process) -> None:

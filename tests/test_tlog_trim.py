@@ -1,0 +1,80 @@
+"""TLog trim semantics: the txs tag must not pin other tags' data.
+
+Regression tests for the trim-horizon rule (server/tlog.py _trim): TXS_TAG
+is popped only by a recovering master, so it is excluded from the horizon
+min; entries below the horizon that still carry unpopped txs data are
+retained txs-only (the reference's separate txnStateStore retention).
+"""
+
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn
+from foundationdb_tpu.server.interfaces import (
+    TLogCommitRequest,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+from foundationdb_tpu.server.systemdata import TXS_TAG
+from foundationdb_tpu.server.tlog import TLog
+
+
+def run(coro):
+    sim = Sim(seed=7)
+    sim.activate()
+    return sim.run_until_done(spawn(coro), 60.0)
+
+
+def test_txs_tag_does_not_pin_trim():
+    async def body():
+        tl = TLog(log_id="t0")
+        prev = 0
+        for v in range(1, 11):
+            msgs = {0: [f"m{v}".encode()]}
+            if v == 3:
+                msgs[TXS_TAG] = [b"meta3"]
+            await tl.commit(
+                TLogCommitRequest(
+                    epoch=0, prev_version=prev, version=v, messages=msgs,
+                    known_committed=0,
+                )
+            )
+            prev = v
+        # storage acks tag 0 through v=8: with the fix, everything but the
+        # txs residue at v=3 trims even though TXS_TAG was never popped
+        await tl.pop(TLogPopRequest(tag=0, upto=8))
+        assert tl._versions == [3, 9, 10], tl._versions
+        v3 = dict(tl._log)[3]
+        assert set(v3) == {TXS_TAG}, "non-txs payload must be stripped"
+
+        # a recovering master can still read the full txs stream
+        reply = await tl.peek(TLogPeekRequest(tag=TXS_TAG, begin=1))
+        assert [v for v, _m in reply.messages] == [3]
+
+        # the master pops txs after its cstate snapshot → residue goes too
+        await tl.pop(TLogPopRequest(tag=TXS_TAG, upto=8))
+        assert tl._versions == [9, 10], tl._versions
+
+    run(body())
+
+
+def test_trim_all_popped_only_txs_left():
+    async def body():
+        tl = TLog(log_id="t1")
+        await tl.commit(
+            TLogCommitRequest(
+                epoch=0, prev_version=0, version=1,
+                messages={TXS_TAG: [b"meta"]}, known_committed=0,
+            )
+        )
+        await tl.commit(
+            TLogCommitRequest(
+                epoch=0, prev_version=1, version=2,
+                messages={1: [b"x"]}, known_committed=0,
+            )
+        )
+        await tl.pop(TLogPopRequest(tag=1, upto=2))
+        # only the txs entry remains; it still serves peeks
+        assert tl._versions == [1]
+        reply = await tl.peek(TLogPeekRequest(tag=TXS_TAG, begin=1))
+        assert [v for v, _m in reply.messages] == [1]
+
+    run(body())
