@@ -1,0 +1,583 @@
+"""Bucket-grid conflict index — the TPU-native MVCC conflict kernel, v2.
+
+Replaces the round-1 sorted-array kernel (tpu_index.py), whose per-batch
+cost was dominated by exactly the operations a TPU is worst at: 18-step
+binary-search gathers, large row scatters, segment-tree walks, and a full
+capacity-sized index rewrite per batch. Measured on the v5e, gathers and
+scatters cost ~25-100 ns *per element* while dense vector ops stream at
+HBM speed — so this design expresses every phase as dense tile work:
+
+    pivots: uint32[B, L]     — lower bound key of bucket b (pivots[0] = 0);
+                               buckets partition keyspace into key ranges
+    grid:   uint32[B, S, L+1]— per bucket: S slots of (boundary key lanes,
+                               gap version), sorted within the bucket;
+                               slot 0 is always the bucket's pivot
+    count:  int32[B]         — used slots per bucket
+    bmax:   int32[B]         — max gap version in bucket (query shortcut)
+
+The MVCC write history is the step function V(key) = version of the gap
+containing key; gaps never span buckets (every pivot is a boundary).
+
+Per batch, everything is a handful of dense ops:
+
+- **history check**: each read endpoint finds its bucket by a dense rank
+  against the pivots (one [Q, B] lex-compare pass — no binary search),
+  block-gathers that bucket's S-slot window (contiguous DMA, not row
+  gathers), and takes masked maxes over the window plus a dense [Q, B]
+  between-buckets max of ``bmax``. The skip list's probe loop
+  (fdbserver/SkipList.cpp:1210 checkReadConflictRanges) becomes ~6 vector
+  passes for the whole batch.
+- **intra-batch check** (the reference's MiniConflictSet,
+  SkipList.cpp:1028): ranges are padded per transaction, so the
+  read-vs-write overlap matrix is a direct dense [T, T] lex compare —
+  no gap partition, no scatters — and the in-order greedy commit
+  recursion runs as an MXU matvec fixpoint.
+- **merge + GC** (mergeWriteConflictRanges / removeBefore,
+  SkipList.cpp:1260,665): committed write endpoints are staged into their
+  buckets (one flat sort of the batch's ~2W endpoints + one small
+  scatter), then every bucket merges old slots with staged rows by a
+  *per-bucket* bitonic sort over its 2S rows (vectorized across all B
+  buckets), forward-fills gap versions with log-shift passes, applies
+  coverage prefix sums, GCs below the horizon, coalesces equal steps, and
+  compacts with one stable flag sort. Work per batch is O(B·S) dense —
+  independent of total history size only through the grid shape, and ~50×
+  less traffic than the round-1 full-index rewrite.
+
+Versions on device are int32 offsets from a host-tracked base (see
+tpu_backend.py). Skew/overflow is handled by the host: each dispatch
+returns per-bucket pressure; the host *reshards* (new pivots from its key
+sample) and replays a group from a state snapshot on overflow — verdicts
+are deterministic, so a replay is invisible to callers.
+
+Sharding story (multi-device resolver): the bucket axis is the natural
+shard axis — each device owns a contiguous pivot range, which is exactly
+key-range partitioning of conflict resolution across resolvers
+(fdbserver/MasterProxyServer.actor.cpp:233 ResolutionRequestBuilder).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+COMMITTED, CONFLICT, TOO_OLD = 0, 1, 2
+
+
+class GridState(NamedTuple):
+    pivots: jax.Array  # uint32[B, L]; unused buckets = all-0xFF
+    grid: jax.Array  # uint32[B, S, L+1]; [..., :L] bounds, [..., L] version
+    count: jax.Array  # int32[B]
+    bmax: jax.Array  # int32[B]
+
+
+class Batch(NamedTuple):
+    """One commit batch, padded per transaction to static shapes.
+
+    Ranges are bucketed per txn (KR read / KW write slots each); inactive
+    slots have begin == end == SENTINEL and self-deactivate in compares.
+    """
+
+    rb: jax.Array  # uint32[T, KR, L]
+    re: jax.Array  # uint32[T, KR, L]
+    wb: jax.Array  # uint32[T, KW, L]
+    we: jax.Array  # uint32[T, KW, L]
+    t_snap: jax.Array  # int32[T]
+    t_has_reads: jax.Array  # bool[T]
+
+
+# ---------------------------------------------------------------------------
+# lex helpers (trailing lane axis, broadcasting)
+
+
+def lex_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    lanes = a.shape[-1]
+    lt = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    eq = jnp.ones_like(lt)
+    for i in range(lanes):
+        ai, bi = a[..., i], b[..., i]
+        lt = lt | (eq & (ai < bi))
+        eq = eq & (ai == bi)
+    return lt
+
+
+def lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
+    return ~lex_lt(b, a)
+
+
+def _rank_le(points: jax.Array, pivots: jax.Array) -> jax.Array:
+    """#(pivots <= point) - 1 per point: dense [N, B] lex compare.
+    points [..., L], pivots [B, L] → int32[...]."""
+    le = lex_le(pivots[None, :, :], points[..., None, :])  # pivot <= point
+    return le.sum(axis=-1, dtype=jnp.int32) - 1
+
+
+def _rank_lt(points: jax.Array, pivots: jax.Array) -> jax.Array:
+    """#(pivots < point) - 1 per point (bucket of point⁻)."""
+    lt = lex_lt(pivots[None, :, :], points[..., None, :])
+    return lt.sum(axis=-1, dtype=jnp.int32) - 1
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: history check
+
+
+def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
+    """bool[T]: some read range overlaps a gap with version > txn snapshot."""
+    T, KR, L = batch.rb.shape
+    B, S, _ = state.grid.shape
+    a = batch.rb.reshape(T * KR, L)
+    e = batch.re.reshape(T * KR, L)
+    active = lex_lt(a, e)
+    snap = jnp.repeat(batch.t_snap, KR)
+
+    ba = _rank_le(a, state.pivots)  # bucket containing a
+    be = _rank_lt(e, state.pivots)  # bucket containing e⁻
+
+    win_a = state.grid[jnp.maximum(ba, 0)]  # [Q, S, L+1] block gather
+    used_a = jnp.arange(S)[None, :] < state.count[jnp.maximum(ba, 0)][:, None]
+    bnd_a = win_a[..., :L]
+    ver_a = win_a[..., L].astype(jnp.int32)
+
+    # value at a: version of the last slot <= a (slot 0 = pivot <= a always)
+    le_a = lex_le(bnd_a, a[:, None, :]) & used_a
+    rank_a = le_a.sum(axis=1, dtype=jnp.int32) - 1
+    onehot = jnp.arange(S)[None, :] == rank_a[:, None]
+    v_at_a = jnp.max(jnp.where(onehot, ver_a, 0), axis=1)
+
+    # gaps starting strictly inside (a, e) within a's bucket
+    inside_a = (
+        used_a
+        & lex_lt(a[:, None, :], bnd_a)
+        & lex_lt(bnd_a, e[:, None, :])
+    )
+    v_in_a = jnp.max(jnp.where(inside_a, ver_a, 0), axis=1)
+
+    # e's bucket (when different): gaps starting before e
+    diff = be > ba
+    win_e = state.grid[jnp.maximum(be, 0)]
+    used_e = jnp.arange(S)[None, :] < state.count[jnp.maximum(be, 0)][:, None]
+    bnd_e = win_e[..., :L]
+    ver_e = win_e[..., L].astype(jnp.int32)
+    in_e = used_e & lex_lt(bnd_e, e[:, None, :])
+    v_in_e = jnp.where(diff, jnp.max(jnp.where(in_e, ver_e, 0), axis=1), 0)
+
+    # buckets strictly between
+    ar = jnp.arange(B, dtype=jnp.int32)[None, :]
+    between = (ar > ba[:, None]) & (ar < be[:, None])
+    v_btw = jnp.max(jnp.where(between, state.bmax[None, :], 0), axis=1)
+
+    vmax = jnp.maximum(jnp.maximum(v_at_a, v_in_a), jnp.maximum(v_in_e, v_btw))
+    hit = active & (vmax > snap)
+    return hit.reshape(T, KR).any(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: intra-batch greedy commit (dense Pji + MXU fixpoint)
+
+
+def intra_batch_commits(batch: Batch, H: jax.Array) -> jax.Array:
+    T, KR, L = batch.rb.shape
+    KW = batch.wb.shape[1]
+    # one [T, T, KW] compare per read slot: program size grows with KR
+    # only, intermediates stay bounded by T²·KW (a full KR×KW broadcast
+    # would square both)
+    Pji = jnp.zeros((T, T), dtype=bool)
+    for ar in range(KR):
+        rb = batch.rb[:, ar, None, None, :]  # [T, 1, 1, L] reads of j
+        re = batch.re[:, ar, None, None, :]
+        wb = batch.wb[None, :, :, :]  # [1, T, KW, L] writes of i
+        we = batch.we[None, :, :, :]
+        # read j overlaps write i: rb_j < we_i and wb_i < re_j
+        o = lex_lt(rb, we) & lex_lt(wb, re)  # [T, T, KW]
+        Pji = Pji | o.any(axis=2)
+    earlier = jnp.arange(T)[None, :] < jnp.arange(T)[:, None]
+    Pf = (Pji & earlier).astype(jnp.bfloat16)
+
+    def body(val):
+        commit, _ = val
+        blocked = (
+            jnp.matmul(Pf, commit.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+            > 0
+        )
+        new = ~H & ~blocked
+        return new, jnp.any(new != commit)
+
+    commit, _ = jax.lax.while_loop(
+        lambda v: v[1], body, (~H, jnp.array(True))
+    )
+    return commit
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: merge committed writes + GC + coalesce (per-bucket dense)
+
+
+def _log_shift_fill(val: jax.Array, have: jax.Array) -> jax.Array:
+    """Forward-fill along axis 1: val where have, else last earlier value.
+    Hillis-Steele log passes (no gathers)."""
+    n = val.shape[1]
+    shift = 1
+    while shift < n:
+        pv = jnp.pad(val, ((0, 0), (shift, 0)))[:, :n]
+        ph = jnp.pad(have, ((0, 0), (shift, 0)))[:, :n]
+        val = jnp.where(have, val, pv)
+        have = have | ph
+        shift <<= 1
+    return val
+
+
+def merge_writes(
+    state: GridState,
+    batch: Batch,
+    commit: jax.Array,
+    now: jax.Array,
+    oldest: jax.Array,
+) -> tuple[GridState, jax.Array]:
+    """Raise V(k) to max(V(k), now) over committed write ranges; GC below
+    ``oldest``; coalesce equal steps. Returns (new_state, pressure) where
+    ``pressure`` = int32[2]: [max staged rows in any bucket (overflow if
+    > S), max kept rows in any bucket (overflow if > S)]."""
+    B, S, Lp1 = state.grid.shape
+    L = Lp1 - 1
+    T, KW, _ = batch.wb.shape
+    Wtot = T * KW
+
+    w_ok = lex_lt(batch.wb, batch.we) & commit[:, None]
+    c = batch.wb.reshape(Wtot, L)
+    d = batch.we.reshape(Wtot, L)
+    ok = w_ok.reshape(Wtot)
+
+    bc = _rank_le(c, state.pivots)
+    bd = _rank_le(d, state.pivots)
+
+    # staged rows: (code, ev) — begins carry +1, ends -1
+    codes = jnp.concatenate([c, d], axis=0)  # [2W, L]
+    evs = jnp.concatenate(
+        [jnp.where(ok, 1, 0), jnp.where(ok, -1, 0)]
+    ).astype(jnp.int32)
+    bkt = jnp.where(
+        jnp.concatenate([ok, ok]),
+        jnp.concatenate([bc, bd]),
+        B,  # invalid → out of range, dropped by scatter
+    ).astype(jnp.int32)
+
+    # per-bucket event carry: events in earlier buckets (a write spanning
+    # buckets keeps later buckets covered until its end event)
+    ar = jnp.arange(B, dtype=jnp.int32)[None, :]
+    evsum = jnp.sum(
+        jnp.where(bkt[:, None] == ar, evs[:, None], 0), axis=0
+    )  # [B]
+    carry = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(evsum)[:-1]]
+    )
+
+    # sort staged rows by (bucket, code), then AGGREGATE equal (bucket,
+    # code) runs: one staged row per distinct boundary, carrying the run's
+    # event sum. Without this, a hot-key batch (many txns writing the same
+    # key) would stage more same-code rows than any repivoting could ever
+    # split across buckets.
+    N2 = 2 * Wtot
+    cols = (bkt,) + tuple(codes[:, i] for i in range(L)) + (evs,)
+    sorted_cols = jax.lax.sort(cols, num_keys=L + 1)
+    sb = sorted_cols[0]
+    scode = jnp.stack(sorted_cols[1 : L + 1], axis=1)
+    sev = sorted_cols[L + 1]
+    idx = jnp.arange(N2, dtype=jnp.int32)
+
+    code_new = jnp.concatenate(
+        [
+            jnp.ones(1, bool),
+            (sb[1:] != sb[:-1]) | (scode[1:] != scode[:-1]).any(axis=1),
+        ]
+    )
+    code_last = jnp.concatenate([code_new[1:], jnp.ones(1, bool)])
+    pe = jnp.cumsum(sev)
+    # event prefix just before each run, forward-filled across the run
+    pe_prev = jnp.concatenate([jnp.zeros(1, jnp.int32), pe[:-1]])
+    pe_before = _log_shift_fill(
+        jnp.where(code_new, pe_prev, 0)[None, :], code_new[None, :]
+    )[0]
+    agg_ev = pe - pe_before  # valid at run-last rows
+
+    bkt_new = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+    rl_cum = jnp.cumsum((code_last & (sb < B)).astype(jnp.int32))
+    rl_cum_prev = jnp.concatenate([jnp.zeros(1, jnp.int32), rl_cum[:-1]])
+    rl_base = _log_shift_fill(
+        jnp.where(bkt_new, rl_cum_prev, 0)[None, :], bkt_new[None, :]
+    )[0]
+    slot = rl_cum - 1 - rl_base  # distinct-code slot within bucket
+
+    staged_cnt = jnp.zeros((B,), jnp.int32).at[sb].add(
+        jnp.where(code_last & (sb < B), 1, 0), mode="drop"
+    )
+    max_staged = jnp.max(staged_cnt)
+
+    # scatter run-last rows into [B, S] staging planes (flat 1-D index)
+    flat = jnp.where(
+        code_last & (sb < B) & (slot < S), sb * S + slot, B * S
+    )
+    st_code = jnp.full((B * S + 1, L), SENTINEL, dtype=jnp.uint32)
+    st_code = st_code.at[flat].set(scode, mode="drop")[: B * S].reshape(
+        B, S, L
+    )
+    st_ev = jnp.zeros((B * S + 1,), jnp.int32).at[flat].set(
+        agg_ev, mode="drop"
+    )[: B * S].reshape(B, S)
+
+    # merged per-bucket rows: old slots (tie 0) then staged (tie 1)
+    M = 2 * S
+    old_bnd = state.grid[..., :L]
+    old_used = jnp.arange(S)[None, :] < state.count[:, None]
+    old_bnd = jnp.where(old_used[..., None], old_bnd, SENTINEL)
+    old_ver = jnp.where(old_used, state.grid[..., L].astype(jnp.int32), 0)
+
+    m_code = jnp.concatenate([old_bnd, st_code], axis=1)  # [B, M, L]
+    m_tie = jnp.concatenate(
+        [jnp.zeros((B, S), jnp.int32), jnp.ones((B, S), jnp.int32)], axis=1
+    )
+    m_ver = jnp.concatenate([old_ver, jnp.zeros((B, S), jnp.int32)], axis=1)
+    m_ev = jnp.concatenate([jnp.zeros((B, S), jnp.int32), st_ev], axis=1)
+    m_old = jnp.concatenate(
+        [old_used.astype(jnp.int32), jnp.zeros((B, S), jnp.int32)], axis=1
+    )
+
+    cols = tuple(m_code[..., i] for i in range(L)) + (
+        m_tie,
+        m_ver,
+        m_ev,
+        m_old,
+    )
+    sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=L + 1)
+    g_code = jnp.stack(sorted_cols[:L], axis=-1)  # [B, M, L]
+    g_ver = sorted_cols[L + 1]
+    g_ev = sorted_cols[L + 2]
+    g_old = sorted_cols[L + 3].astype(bool)
+
+    # forward-fill gap base values from old rows
+    base = _log_shift_fill(jnp.where(g_old, g_ver, 0), g_old)
+
+    # coverage prefix: gap starting at row m is covered iff carry + Σ ev > 0
+    cov = carry[:, None] + jnp.cumsum(g_ev, axis=1)
+    covered = cov > 0
+
+    nv = jnp.where(covered, jnp.maximum(base, now), base)
+    nv = jnp.where(nv < oldest, 0, nv)
+
+    is_sent = (g_code == SENTINEL).all(axis=-1)
+    # dedupe: keep last row of each equal-code run (it has the full prefix)
+    nxt_differs = jnp.concatenate(
+        [
+            (g_code[:, 1:] != g_code[:, :-1]).any(axis=-1),
+            jnp.ones((B, 1), bool),
+        ],
+        axis=1,
+    )
+    keep = (~is_sent) & nxt_differs
+    # coalesce: drop a run whose value equals the previous run's value
+    # (transitive through dropped runs, since equality is transitive).
+    # Previous run's value = nv at the row just before this run's first
+    # row; broadcast it across the run with a forward fill. The first run
+    # of each bucket (the pivot boundary) sees the pad value -1, never
+    # equal to a version, so it is always kept — preserving the
+    # slot-0-is-the-pivot invariant.
+    shifted_nv = jnp.pad(nv, ((0, 0), (1, 0)), constant_values=-1)[:, :M]
+    first_of_run = jnp.concatenate(
+        [
+            jnp.ones((B, 1), bool),
+            (g_code[:, 1:] != g_code[:, :-1]).any(axis=-1),
+        ],
+        axis=1,
+    )
+    pval = _log_shift_fill(
+        jnp.where(first_of_run, shifted_nv, 0), first_of_run
+    )
+    keep = keep & (nv != pval)
+
+    kept_cnt = keep.sum(axis=1, dtype=jnp.int32)
+    max_kept = jnp.max(kept_cnt)
+
+    # compact: stable sort by !keep, take first S rows
+    cols = (jnp.where(keep, 0, 1).astype(jnp.int32),) + tuple(
+        g_code[..., i] for i in range(L)
+    ) + (nv,)
+    sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=1, is_stable=True)
+    out_code = jnp.stack(sorted_cols[1 : L + 1], axis=-1)[:, :S, :]
+    out_ver = sorted_cols[L + 1][:, :S]
+
+    new_count = jnp.minimum(kept_cnt, S)
+    used = jnp.arange(S)[None, :] < new_count[:, None]
+    out_code = jnp.where(used[..., None], out_code, SENTINEL)
+    out_ver = jnp.where(used, out_ver, 0)
+    new_grid = jnp.concatenate(
+        [out_code, out_ver.astype(jnp.uint32)[..., None]], axis=-1
+    )
+    new_bmax = jnp.max(out_ver, axis=1)
+
+    pressure = jnp.stack([max_staged, max_kept])
+    return (
+        GridState(state.pivots, new_grid, new_count, new_bmax),
+        pressure,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full resolver step
+
+
+def _resolve_one(state, batch, now, oldest_pre, oldest_post):
+    too_old = batch.t_has_reads & (batch.t_snap < oldest_pre)
+    H = history_conflicts(state, batch) | too_old
+    commit = intra_batch_commits(batch, H)
+    new_state, pressure = merge_writes(state, batch, commit, now, oldest_post)
+    verdicts = jnp.where(
+        too_old,
+        jnp.int8(TOO_OLD),
+        jnp.where(commit, jnp.int8(COMMITTED), jnp.int8(CONFLICT)),
+    )
+    return new_state, verdicts, pressure
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def resolve_batch(
+    state: GridState,
+    batch: Batch,
+    now: jax.Array,
+    oldest_pre: jax.Array,
+    oldest_post: jax.Array,
+):
+    """One batch end-to-end. Returns (state, verdicts int8[T], pressure)."""
+    return _resolve_one(state, batch, now, oldest_pre, oldest_post)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def resolve_many(
+    state: GridState,
+    batches: Batch,  # leading group axis G on every leaf
+    nows: jax.Array,
+    oldests_pre: jax.Array,
+    oldests_post: jax.Array,
+):
+    """G batches in one dispatch via lax.scan (state threads on device) —
+    the device-side analog of the reference's pipelined commit batches
+    (MasterProxyServer.actor.cpp:353). Returns (state, verdicts int8[G,T],
+    pressure int32[2] = max over the group)."""
+
+    def step(st, inp):
+        batch, now, old_pre, old_post = inp
+        st2, verdicts, pressure = _resolve_one(st, batch, now, old_pre, old_post)
+        return st2, (verdicts, pressure)
+
+    state, (verdicts, pressures) = jax.lax.scan(
+        step, state, (batches, nows, oldests_pre, oldests_post)
+    )
+    return state, verdicts, jnp.max(pressures, axis=0)
+
+
+@jax.jit
+def rebase(state: GridState, delta: jax.Array) -> GridState:
+    """Shift the version origin by ``delta`` (host advances its base)."""
+    ver = state.grid[..., -1].astype(jnp.int32)
+    used = jnp.arange(state.grid.shape[1])[None, :] < state.count[:, None]
+    ver = jnp.where(used, jnp.maximum(ver - delta, 0), 0)
+    grid = jnp.concatenate(
+        [state.grid[..., :-1], ver.astype(jnp.uint32)[..., None]], axis=-1
+    )
+    return GridState(state.pivots, grid, state.count, jnp.max(ver, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# Host-side construction / resharding (rare, numpy)
+
+
+def make_state(n_buckets: int, n_slots: int, lanes: int) -> GridState:
+    """Fresh index: one live bucket [0, ∞) with version 0 everywhere."""
+    pivots = np.full((n_buckets, lanes), 0xFFFFFFFF, dtype=np.uint32)
+    pivots[0] = 0
+    grid = np.full((n_buckets, n_slots, lanes + 1), 0xFFFFFFFF, dtype=np.uint32)
+    grid[..., lanes] = 0
+    grid[0, 0, :lanes] = 0
+    count = np.zeros((n_buckets,), np.int32)
+    count[0] = 1
+    return GridState(
+        pivots=jnp.asarray(pivots),
+        grid=jnp.asarray(grid),
+        count=jnp.asarray(count),
+        bmax=jnp.zeros((n_buckets,), jnp.int32),
+    )
+
+
+def reshard_host(
+    state: GridState, new_pivot_codes: np.ndarray, n_buckets: int, n_slots: int
+) -> GridState:
+    """Rebuild the grid under new pivots (numpy; rare — init, growth, or
+    skew). Preserves the step function exactly: every live boundary is
+    re-bucketed and each new pivot becomes a boundary inheriting the value
+    of the gap containing it."""
+    pivots_old = np.asarray(state.pivots)
+    grid = np.asarray(state.grid)
+    count = np.asarray(state.count)
+    B_old, S_old, Lp1 = grid.shape
+    L = Lp1 - 1
+
+    rows = []
+    for b in range(B_old):
+        for s in range(int(count[b])):
+            rows.append((tuple(int(x) for x in grid[b, s, :L]), int(grid[b, s, L])))
+    rows.sort()
+
+    piv = [tuple(int(x) for x in p) for p in new_pivot_codes]
+    assert piv[0] == tuple([0] * L), "pivot 0 must be the empty key"
+    assert len(piv) <= n_buckets
+
+    import bisect as _b
+
+    keys = [r[0] for r in rows]
+    new_grid = np.full((n_buckets, n_slots, Lp1), 0xFFFFFFFF, dtype=np.uint32)
+    new_count = np.zeros((n_buckets,), np.int32)
+    new_bmax = np.zeros((n_buckets,), np.int32)
+    bounds_per = [[] for _ in range(len(piv))]
+    for k, v in rows:
+        nb = _b.bisect_right(piv, k) - 1
+        bounds_per[nb].append((k, v))
+    for nb, plist in enumerate(bounds_per):
+        # pivot row first, inheriting the gap value at the pivot
+        if not plist or plist[0][0] != piv[nb]:
+            i = _b.bisect_right(keys, piv[nb]) - 1
+            inherit = rows[i][1] if i >= 0 else 0
+            plist.insert(0, (piv[nb], inherit))
+        # coalesce: drop a boundary whose step value equals the previous
+        # kept one (the pivot row at index 0 is always kept); duplicate
+        # keys keep the later value
+        out = []
+        for k, v in plist:
+            if out and out[-1][0] == k:
+                out[-1] = (k, v)
+                continue
+            if out and out[-1][1] == v:
+                continue
+            out.append((k, v))
+        if len(out) > n_slots:
+            raise OverflowError(
+                f"bucket {nb} needs {len(out)} slots > {n_slots}"
+            )
+        for s, (k, v) in enumerate(out):
+            new_grid[nb, s, :L] = k
+            new_grid[nb, s, L] = v
+        new_count[nb] = len(out)
+        new_bmax[nb] = max((v for _k, v in out), default=0)
+    new_pivots = np.full((n_buckets, L), 0xFFFFFFFF, dtype=np.uint32)
+    for nb, p in enumerate(piv):
+        new_pivots[nb] = p
+    return GridState(
+        pivots=jnp.asarray(new_pivots),
+        grid=jnp.asarray(new_grid),
+        count=jnp.asarray(new_count),
+        bmax=jnp.asarray(new_bmax),
+    )

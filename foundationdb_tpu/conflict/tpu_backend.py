@@ -1,39 +1,57 @@
-"""Host glue for the TPU conflict-detection kernel.
+"""Host glue for the bucket-grid TPU conflict kernel (conflict/grid.py).
 
-``TpuConflictSet`` implements the ConflictSet interface (conflict/api.py) on
-top of the functional device index in tpu_index.py:
+``TpuConflictSet`` implements the ConflictSet interface (conflict/api.py):
 
 - encodes byte-string conflict ranges to fixed-width lane codes
-  (conflict/keys.py), padding batches to power-of-two buckets so jit
-  specializations stay bounded;
-- tracks the int64→int32 version rebasing origin (device versions are
-  offsets; the host rebases when the offset approaches int32 range);
-- pre-grows index capacity before a batch could overflow it (merged boundary
-  count is at most n + 2·writes, so growth never needs a device round-trip
-  retry);
-- converts device verdicts back to the API's Verdict enum.
+  (conflict/keys.py), padded *per transaction* (KR read / KW write range
+  slots) so the kernel's intra-batch check is a dense [T, T] compare;
+- tracks the int64→int32 version rebasing origin;
+- owns the **reshard loop**: the device returns per-group bucket pressure;
+  on overflow the host rebuilds the grid under fresh pivots (quantiles of
+  the live boundary set / its key sample) from a pre-group snapshot and
+  replays the group — verdicts are deterministic, so callers never see it.
+  Proactive reshards run between groups when pressure nears the slot
+  capacity, growing the bucket count when the index genuinely fills.
 
-The same class runs unmodified on CPU (JAX_PLATFORMS=cpu) — that is the
-deterministic simulation twin the test suite uses, mirroring how the
-reference runs its resolver under deterministic simulation (SURVEY.md §4).
+The same class runs unmodified on CPU (JAX_PLATFORMS=cpu) — the
+deterministic simulation twin the test suite uses (SURVEY.md §4: TPU
+kernels must have a sim-mode CPU twin).
 """
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
+from . import grid as G
 from . import keys as K
-from . import tpu_index as TI
 from .api import CommitTransaction, ConflictSet, Verdict
 
 _INT32_REBASE_THRESHOLD = 1 << 30
+_SAMPLE_CAP = 32768
 
 
-def _bucket(n: int, floor: int = 32) -> int:
+def _bucket(n: int, floor: int = 1) -> int:
     b = floor
     while b < n:
         b <<= 1
     return b
+
+
+def _pick_pivots(cands, zero, n_buckets: int, lanes: int) -> np.ndarray:
+    """≤ n_buckets-1 quantile pivots from sorted candidate codes; bucket 0
+    always starts at the empty key."""
+    n_piv = min(n_buckets - 1, len(cands))
+    pivots = [zero]
+    seen = {zero}
+    if n_piv > 0:
+        step = len(cands) / (n_piv + 1)
+        for i in range(1, n_piv + 1):
+            c = cands[min(int(i * step), len(cands) - 1)]
+            if c not in seen:
+                seen.add(c)
+                pivots.append(c)
+    return np.asarray(pivots, dtype=np.uint32).reshape(-1, lanes)
 
 
 class TpuConflictSet(ConflictSet):
@@ -41,76 +59,44 @@ class TpuConflictSet(ConflictSet):
         super().__init__()
         self._width = key_width
         self._lanes = K.lanes_for_width(key_width)
-        self._capacity = capacity
-        self._state = TI.make_state(capacity, self._lanes)
-        # Conservative host-side bound on the device boundary count (reading
-        # state.n would force a device sync per batch). n only grows by at
-        # most 2·writes per batch and GC only shrinks it.
-        self._n_bound = 1
-        # Device versions are stored as (version - base); base starts at -1 so
-        # every live version maps to >= 1 (0 means "never written").
-        self._base = -1
+        # grid shape: B buckets × S slots with ~2× slack over `capacity`
+        # boundaries; generous S so a batch's staged rows fit alongside
+        self._B = _bucket(max(8, capacity // 32))
+        self._S = 64 if self._B >= 1024 else 32
+        self._state = G.make_state(self._B, self._S, self._lanes)
+        self._base = -1  # device versions are (version - base); 0 = never
         self._base_epoch = 0
+        # reservoir of raw endpoint keys for pivot selection
+        self._sample: list[bytes] = []
+        self._sample_skip = 0
+        self._resharded_once = False
+        # dispatched-but-uncollected groups, in dispatch order
+        self._inflight: list[dict] = []
 
     # -- ConflictSet interface ------------------------------------------------
 
+    def _flush(self) -> None:
+        while self._inflight:
+            self._collect(self._inflight[0])
+
     def clear(self, version: int) -> None:
-        self._state = TI.make_state(self._capacity, self._lanes)
-        self._n_bound = 1
+        self._flush()
+        self._state = G.make_state(self._B, self._S, self._lanes)
         self._base = version - 1
         self._base_epoch += 1
+        self._resharded_once = False
         self.oldest_version = version
 
     def detect_batch(
         self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
     ) -> list[Verdict]:
-        return self.detect_batch_async(transactions, now, new_oldest_version)()
-
-    def detect_batch_async(
-        self, transactions: list[CommitTransaction], now: int, new_oldest_version: int
-    ):
-        """Dispatch one batch without waiting for the device; returns a
-        zero-arg callable yielding the verdict list.
-
-        Under the axon tunnel a host↔device round trip costs ~70ms, so the
-        resolver pipelines: dispatch batch k+1 while k's verdicts are still
-        in flight (the reference's phase-gated batch pipelining,
-        MasterProxyServer.actor.cpp:353)."""
-        self._maybe_rebase(now)  # before encoding: snapshots are base-relative
-        batch, num_txns = self._encode(transactions)
-        self._ensure_capacity(2 * int(batch.wb.shape[0]))
-
-        # TOO_OLD gates on the pre-batch horizon; GC applies the post-batch
-        # horizon — matching the reference's ordering (addTransaction checks
-        # cs->oldestVersion, SkipList.cpp:989; removeBefore at :1195).
-        horizon = max(self.oldest_version, new_oldest_version)
-        state, verdicts, _needed = TI.resolve_batch(
-            self._state,
-            batch,
-            np.int32(now - self._base),
-            np.int32(max(self.oldest_version - self._base, 0)),
-            np.int32(max(horizon - self._base, 0)),
-            num_txns,
-        )
-        self._state = state
-        self._n_bound = min(
-            self._n_bound + 2 * int(batch.wb.shape[0]), self._capacity
-        )
-        self.oldest_version = horizon
-        n = len(transactions)
-
-        def result(verdicts=verdicts, n=n):
-            out = np.asarray(verdicts[:n])
-            return [Verdict(int(v)) for v in out]
-
-        return result
+        return self.detect_many([(transactions, now, new_oldest_version)])[0]
 
     def detect_many(
         self, work: list[tuple[list[CommitTransaction], int, int]]
     ) -> list[list[Verdict]]:
-        """Resolve many (transactions, now, new_oldest) batches in one device
-        dispatch via lax.scan (TI.resolve_many). All batches are padded to
-        shared bucket shapes."""
+        """Resolve many (transactions, now, new_oldest) batches in one
+        device dispatch (grid.resolve_many lax.scan)."""
         if not work:
             return []
         self._maybe_rebase(max(now for _, now, _2 in work))
@@ -120,163 +106,246 @@ class TpuConflictSet(ConflictSet):
 
     def encode(self, transactions: list[CommitTransaction]):
         """Pre-encode a batch for detect_many_encoded. Encodings are
-        horizon-independent but base-relative: a version rebase invalidates
-        them (guarded via the epoch stamp)."""
-        b, T = self._encode(transactions)
-        return b, T, len(transactions), self._base_epoch
+        base-relative: a version rebase invalidates them (epoch stamp)."""
+        b = self._encode(transactions)
+        return b, len(transactions), self._base_epoch
 
     def detect_many_encoded(self, work) -> list[list[Verdict]]:
-        """work: list of (encoded, now, new_oldest), encoded from encode()."""
+        """work: list of ((Batch, n_real, epoch), now, new_oldest)."""
+        return self.detect_many_encoded_async(work)()
+
+    def detect_many_encoded_async(self, work):
+        """Dispatch a group without waiting; returns a zero-arg callable
+        yielding the verdict lists. The caller may dispatch further groups
+        before collecting — the inter-group state dependency lives on
+        device, so dispatches pipeline and the host↔device round trip is
+        paid once per *collection*, not per group (the commit pipeline's
+        phase overlap, MasterProxyServer.actor.cpp:353, applied to the
+        tunnel)."""
         if not work:
-            return []
-        encoded = []
+            return lambda: []
         counts = []
-        for (b, T, n_real, epoch), now, new_oldest in work:
+        metas = []  # (now, oldest_pre, oldest_post) absolute versions
+        batches = []
+        for (b, n_real, epoch), now, new_oldest in work:
             if epoch != self._base_epoch:
                 raise RuntimeError(
                     "stale encoding: version base was rebased after encode()"
                 )
-            old_pre = self.oldest_version
             horizon = max(self.oldest_version, new_oldest)
-            encoded.append((b, T, now, old_pre, horizon))
-            counts.append(n_real)
+            metas.append((now, self.oldest_version, horizon))
             self.oldest_version = horizon
-        return self._detect_encoded(encoded, counts)
+            counts.append(n_real)
+            batches.append(b)
 
-    def _detect_encoded(self, encoded, counts) -> list[list[Verdict]]:
-        self._ensure_capacity(sum(2 * int(b.wb.shape[0]) for b, *_ in encoded))
+        if not self._resharded_once:
+            self._reshard(self._state)
 
-        # Re-pad every batch to the group-max bucket shapes and stack.
-        Tm = max(T for _, T, *_ in encoded)
-        Rm = max(int(b.rb.shape[0]) for b, *_ in encoded)
-        Wm = max(int(b.wb.shape[0]) for b, *_ in encoded)
-        stacked = TI.Batch(
-            rb=np.stack([self._pad2(b.rb, Rm) for b, *_ in encoded]),
-            re=np.stack([self._pad2(b.re, Rm) for b, *_ in encoded]),
-            r_snap=np.stack([self._pad1(b.r_snap, Rm) for b, *_ in encoded]),
-            r_owner=np.stack([self._pad1(b.r_owner, Rm) for b, *_ in encoded]),
-            wb=np.stack([self._pad2(b.wb, Wm) for b, *_ in encoded]),
-            we=np.stack([self._pad2(b.we, Wm) for b, *_ in encoded]),
-            w_owner=np.stack([self._pad1(b.w_owner, Wm) for b, *_ in encoded]),
-            t_snap=np.stack([self._pad1(b.t_snap, Tm) for b, *_ in encoded]),
-            t_has_reads=np.stack(
-                [self._pad1(b.t_has_reads, Tm) for b, *_ in encoded]
-            ),
-        )
-        nows = np.asarray(
-            [now - self._base for _, _, now, *_ in encoded], np.int32
-        )
+        stacked = self._stack(batches)
+        group = {
+            "stacked": stacked,
+            "metas": metas,
+            "counts": counts,
+            "done": None,
+        }
+        self._dispatch(group)
+        self._inflight.append(group)
+
+        def result(group=group):
+            return self._collect(group)
+
+        return result
+
+    def _dispatch(self, group) -> None:
+        metas = group["metas"]
+        nows = np.asarray([m[0] - self._base for m in metas], np.int32)
         olds_pre = np.asarray(
-            [max(p - self._base, 0) for *_, p, _h in encoded], np.int32
+            [max(m[1] - self._base, 0) for m in metas], np.int32
         )
         olds_post = np.asarray(
-            [max(h - self._base, 0) for *_, h in encoded], np.int32
+            [max(m[2] - self._base, 0) for m in metas], np.int32
         )
-        state, verdicts, _needed = TI.resolve_many(
-            self._state, stacked, nows, olds_pre, olds_post, Tm
+        # copy before dispatch: resolve_many donates the state buffers
+        group["snapshot"] = jax.tree_util.tree_map(lambda x: x + 0, self._state)
+        state, verdicts, pressure = G.resolve_many(
+            self._state, group["stacked"], nows, olds_pre, olds_post
         )
         self._state = state
-        for b, *_ in encoded:
-            self._n_bound = min(
-                self._n_bound + 2 * int(b.wb.shape[0]), self._capacity
-            )
-        out = np.asarray(verdicts)
-        return [
-            [Verdict(int(v)) for v in out[g, : counts[g]]]
-            for g in range(len(encoded))
+        group["verdicts"] = verdicts
+        group["pressure"] = pressure
+
+    def _collect(self, group) -> list[list[Verdict]]:
+        if group["done"] is not None:
+            return group["done"]
+        # collect in dispatch order (earlier groups first: a replay there
+        # invalidates everything after)
+        while self._inflight and self._inflight[0] is not group:
+            self._collect(self._inflight[0])
+        assert self._inflight and self._inflight[0] is group
+        for attempt in range(6):
+            pr = np.asarray(group["pressure"])
+            if int(pr.max(initial=0)) <= self._S:
+                break
+            # overflow: some bucket needed more slots than S — rebuild the
+            # grid under fresh pivots from the pre-group snapshot, then
+            # replay this group and everything dispatched after it
+            self._reshard(group["snapshot"], grow=attempt >= 1)
+            for g in self._inflight:
+                self._dispatch(g)
+        else:
+            raise RuntimeError("conflict grid reshard did not converge")
+        if int(pr.max(initial=0)) > self._S - max(4, self._S // 4):
+            # close to the slot ceiling: rebalance before the next group
+            # (only safe with nothing else in flight on the old layout)
+            if len(self._inflight) == 1:
+                self._reshard(self._state, grow=int(pr[1]) > self._S - 4)
+        out = np.asarray(group["verdicts"])
+        group["done"] = [
+            [Verdict(int(v)) for v in out[g, : group["counts"][g]]]
+            for g in range(len(group["counts"]))
         ]
-
-    @staticmethod
-    def _pad2(a: np.ndarray, size: int) -> np.ndarray:
-        if a.shape[0] == size:
-            return a
-        out = np.full((size, a.shape[1]), 0xFFFFFFFF, dtype=a.dtype)
-        out[: a.shape[0]] = a
-        return out
-
-    @staticmethod
-    def _pad1(a: np.ndarray, size: int) -> np.ndarray:
-        if a.shape[0] == size:
-            return a
-        out = np.zeros((size,), dtype=a.dtype)
-        out[: a.shape[0]] = a
-        return out
+        # collected groups can never be re-dispatched: drop everything
+        # pinning device/host memory (snapshots scale with pipeline depth)
+        group.pop("snapshot", None)
+        group.pop("verdicts", None)
+        group.pop("stacked", None)
+        group.pop("metas", None)
+        self._inflight.pop(0)
+        return group["done"]
 
     # -- internals ------------------------------------------------------------
 
-    def _encode(self, transactions):
-        """Encode a batch to device arrays. Horizon-independent: TOO_OLD is
-        determined on device from per-transaction snapshots, so pre-encoded
-        batches stay valid as the horizon advances. Only a version rebase
-        invalidates an encoding (checked via _base_epoch)."""
-        reads: list[tuple[bytes, bytes, int, int]] = []
-        writes: list[tuple[bytes, bytes, int]] = []
-        t_snap_l = []
-        t_has_reads_l = []
-        for t, tr in enumerate(transactions):
-            snap = max(tr.read_snapshot - self._base, 0)
-            t_snap_l.append(snap)
-            t_has_reads_l.append(bool(tr.read_conflict_ranges))
-            for (b, e) in tr.read_conflict_ranges:
-                reads.append((b, e, snap, t))
-            for (b, e) in tr.write_conflict_ranges:
-                writes.append((b, e, t))
-
-        T = _bucket(max(len(transactions), 1))
-        R = _bucket(max(len(reads), 1))
-        W = _bucket(max(len(writes), 1))
+    def _encode(self, transactions) -> G.Batch:
+        n = max(len(transactions), 1)
+        T = _bucket(n, 8)
+        KR = _bucket(
+            max((len(t.read_conflict_ranges) for t in transactions), default=0)
+            or 1
+        )
+        KW = _bucket(
+            max((len(t.write_conflict_ranges) for t in transactions), default=0)
+            or 1
+        )
         sent = K.max_sentinel(self._width)
+        rb = np.tile(sent, (T, KR, 1))
+        re = np.tile(sent, (T, KR, 1))
+        wb = np.tile(sent, (T, KW, 1))
+        we = np.tile(sent, (T, KW, 1))
+        t_snap = np.zeros(T, np.int32)
+        t_has_reads = np.zeros(T, bool)
 
-        def pad_codes(ks: list[bytes], size: int, round_up: bool) -> np.ndarray:
-            out = np.tile(sent, (size, 1))
-            if ks:
-                out[: len(ks)] = K.encode_keys(ks, self._width, round_up=round_up)
+        r_begins, r_ends, w_begins, w_ends = [], [], [], []
+        r_pos, w_pos = [], []
+        for t, tr in enumerate(transactions):
+            t_snap[t] = max(tr.read_snapshot - self._base, 0)
+            t_has_reads[t] = bool(tr.read_conflict_ranges)
+            for i, (b, e) in enumerate(tr.read_conflict_ranges):
+                r_begins.append(b)
+                r_ends.append(e)
+                r_pos.append((t, i))
+            for i, (b, e) in enumerate(tr.write_conflict_ranges):
+                w_begins.append(b)
+                w_ends.append(e)
+                w_pos.append((t, i))
+                self._sample_key(b)
+                self._sample_key(e)
+
+        if r_begins:
+            cb = K.encode_keys(r_begins, self._width, round_up=False)
+            ce = K.encode_keys(r_ends, self._width, round_up=True)
+            for (t, i), eb, ee in zip(r_pos, cb, ce):
+                rb[t, i] = eb
+                re[t, i] = ee
+        if w_begins:
+            cb = K.encode_keys(w_begins, self._width, round_up=False)
+            ce = K.encode_keys(w_ends, self._width, round_up=True)
+            for (t, i), eb, ee in zip(w_pos, cb, ce):
+                wb[t, i] = eb
+                we[t, i] = ee
+
+        return G.Batch(
+            rb=rb, re=re, wb=wb, we=we, t_snap=t_snap, t_has_reads=t_has_reads
+        )
+
+    def _stack(self, batches: list[G.Batch]) -> G.Batch:
+        T = max(b.rb.shape[0] for b in batches)
+        KR = max(b.rb.shape[1] for b in batches)
+        KW = max(b.wb.shape[1] for b in batches)
+        sent_row = np.full(self._lanes, 0xFFFFFFFF, dtype=np.uint32)
+
+        def pad3(a, k):
+            t, kk, L = a.shape
+            if t == T and kk == k:
+                return a
+            out = np.tile(sent_row, (T, k, 1))
+            out[:t, :kk] = a
             return out
 
-        # Range begins round down, ends round up: a truncated range can only
-        # widen (conflict/keys.py), never collapse to empty.
-        rb = pad_codes([r[0] for r in reads], R, False)
-        re = pad_codes([r[1] for r in reads], R, True)
-        # padded slots: rb == re == sentinel → inactive (rb >= re)
-        r_snap = np.zeros(R, np.int32)
-        r_snap[: len(reads)] = [r[2] for r in reads]
-        r_owner = np.zeros(R, np.int32)
-        r_owner[: len(reads)] = [r[3] for r in reads]
+        def pad1(a, dtype):
+            if a.shape[0] == T:
+                return a
+            out = np.zeros(T, dtype)
+            out[: a.shape[0]] = a
+            return out
 
-        wb = pad_codes([w[0] for w in writes], W, False)
-        we = pad_codes([w[1] for w in writes], W, True)
-        w_owner = np.zeros(W, np.int32)
-        w_owner[: len(writes)] = [w[2] for w in writes]
-
-        t_snap = np.zeros(T, np.int32)
-        t_snap[: len(t_snap_l)] = t_snap_l
-        t_has_reads = np.zeros(T, bool)
-        t_has_reads[: len(t_has_reads_l)] = t_has_reads_l
-
-        batch = TI.Batch(
-            rb=rb, re=re, r_snap=r_snap, r_owner=r_owner,
-            wb=wb, we=we, w_owner=w_owner,
-            t_snap=t_snap, t_has_reads=t_has_reads,
+        return G.Batch(
+            rb=np.stack([pad3(b.rb, KR) for b in batches]),
+            re=np.stack([pad3(b.re, KR) for b in batches]),
+            wb=np.stack([pad3(b.wb, KW) for b in batches]),
+            we=np.stack([pad3(b.we, KW) for b in batches]),
+            t_snap=np.stack([pad1(b.t_snap, np.int32) for b in batches]),
+            t_has_reads=np.stack([pad1(b.t_has_reads, bool) for b in batches]),
         )
-        return batch, T
+
+    def _sample_key(self, key: bytes) -> None:
+        self._sample_skip += 1
+        if len(self._sample) < _SAMPLE_CAP:
+            self._sample.append(key)
+        elif self._sample_skip % 17 == 0:
+            self._sample[self._sample_skip % _SAMPLE_CAP] = key
+
+    def _reshard(self, from_state: G.GridState, grow: bool = False) -> None:
+        """Rebuild the grid under fresh pivots: quantiles of the live
+        boundary set (plus the key sample early on). Rare: init, skew,
+        growth."""
+        state = from_state
+        grid = np.asarray(state.grid)
+        count = np.asarray(state.count)
+        L = self._lanes
+
+        live: list[tuple] = []
+        for b in range(grid.shape[0]):
+            for s in range(int(count[b])):
+                live.append(tuple(int(x) for x in grid[b, s, :L]))
+        if self._sample:
+            codes = K.encode_keys(self._sample, self._width)
+            live.extend(tuple(int(x) for x in c) for c in codes)
+        live = sorted(set(live))
+
+        n_live = sum(int(c) for c in count)
+        if grow or n_live * 2 > self._B * self._S:
+            self._B *= 2
+
+        zero = tuple([0] * L)
+        cands = [c for c in live if c != zero]
+        while True:
+            pivot_codes = _pick_pivots(cands, zero, self._B, L)
+            try:
+                self._state = G.reshard_host(state, pivot_codes, self._B, self._S)
+                break
+            except OverflowError:
+                # quantile split still left some bucket over capacity:
+                # grow and retry with more pivots available
+                self._B *= 2
+        self._resharded_once = True
 
     def _maybe_rebase(self, now: int) -> None:
         if now - self._base < _INT32_REBASE_THRESHOLD:
             return
+        self._flush()  # in-flight groups were encoded against the old base
         new_base = self.oldest_version - 1
         delta = new_base - self._base
         if delta > 0:
-            self._state = TI.rebase(self._state, np.int32(delta))
+            self._state = G.rebase(self._state, np.int32(delta))
             self._base = new_base
             self._base_epoch += 1
-
-    def _ensure_capacity(self, extra: int) -> None:
-        # needed <= n + extra; grow until that fits (keeps resolve_*'s state
-        # donation safe — no retry path). Only when the conservative bound is
-        # tight do we pay one device sync to learn the true n.
-        if self._n_bound + extra <= self._capacity:
-            return
-        self._n_bound = max(int(self._state.n), 1)
-        while self._n_bound + extra > self._capacity:
-            self._capacity *= 2
-            self._state = TI.grow_state(self._state, self._capacity)

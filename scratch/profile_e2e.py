@@ -1,0 +1,108 @@
+"""End-to-end timing of resolve_batch / resolve_many at bench shapes.
+
+Run: python scratch/profile_e2e.py   (no PYTHONPATH — breaks axon discovery)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.conflict import tpu_index as TI
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from bench import make_batches
+
+print("devices:", jax.devices(), flush=True)
+
+TXNS = 2500
+P = 1 << 17
+L = 8
+NLIVE = 131072
+G = 20
+
+rng = np.random.default_rng(0)
+raw = rng.integers(0, 2**32, size=(NLIVE, L), dtype=np.uint32)
+raw[NLIVE - 1] = 0xFFFFFFFF
+order = np.lexsort(tuple(raw[:, i] for i in reversed(range(L))))
+bounds = np.full((P, L), 0xFFFFFFFF, dtype=np.uint32)
+bounds[:NLIVE] = raw[order]
+bounds[0] = 0
+vers = np.zeros(P, np.int32)
+vers[:NLIVE] = rng.integers(1, 50, size=NLIVE)
+
+
+def fresh_state():
+    return TI.IndexState(
+        bounds=jnp.asarray(bounds),
+        vers=jnp.asarray(vers),
+        tree=TI.build_tree(jnp.asarray(vers)),
+        n=jnp.int32(NLIVE),
+    )
+
+
+cs = TpuConflictSet(capacity=P)
+batches = make_batches(G, TXNS)
+encs = [cs._encode(txs)[0] for txs in batches]
+num_txns = cs._encode(batches[0])[1]
+
+# raw dispatch overhead
+@jax.jit
+def null_fn(x):
+    return x + 1
+
+
+x = jnp.zeros((8,), jnp.int32)
+jax.block_until_ready(null_fn(x))
+t0 = time.perf_counter()
+for _ in range(20):
+    x = null_fn(x)
+jax.block_until_ready(x)
+print(f"null dispatch:       {(time.perf_counter()-t0)/20*1e3:8.2f} ms", flush=True)
+
+# host->device transfer of one encoded batch
+t0 = time.perf_counter()
+for i in range(10):
+    b = jax.device_put(encs[i % G])
+    jax.block_until_ready(b)
+print(f"batch h2d transfer:  {(time.perf_counter()-t0)/10*1e3:8.2f} ms", flush=True)
+
+# single resolve_batch, state threading (donated)
+state = fresh_state()
+jax.block_until_ready(state)
+now = jnp.int32(60)
+t0 = time.perf_counter()
+state, verdicts, needed = TI.resolve_batch(
+    state, jax.device_put(encs[0]), now, jnp.int32(1), jnp.int32(5), num_txns
+)
+jax.block_until_ready(verdicts)
+print(f"resolve_batch compile: {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+N = 10
+for i in range(N):
+    state, verdicts, needed = TI.resolve_batch(
+        state, jax.device_put(encs[(i + 1) % G]), now + i,
+        jnp.int32(1 + i), jnp.int32(5 + i), num_txns
+    )
+jax.block_until_ready(verdicts)
+print(f"resolve_batch:       {(time.perf_counter()-t0)/N*1e3:8.2f} ms/batch", flush=True)
+
+# resolve_many over G batches
+cs2 = TpuConflictSet(capacity=P)
+cs2._state = fresh_state()
+cs2._n_bound = NLIVE
+work_enc = [cs2.encode(txs) for txs in batches]
+t0 = time.perf_counter()
+out = cs2.detect_many_encoded([(e, 60 + i, 10 + i) for i, e in enumerate(work_enc)])
+print(f"resolve_many compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+cs3 = TpuConflictSet(capacity=P)
+cs3._state = fresh_state()
+cs3._n_bound = NLIVE
+t0 = time.perf_counter()
+out = cs3.detect_many_encoded([(e, 60 + i, 10 + i) for i, e in enumerate(work_enc)])
+dt = time.perf_counter() - t0
+print(f"resolve_many:        {dt/G*1e3:8.2f} ms/batch ({dt:.2f}s for {G})", flush=True)

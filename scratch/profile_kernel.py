@@ -1,0 +1,196 @@
+"""Phase-level timing of the current TPU conflict kernel at bench shapes.
+
+Run from anywhere: python scratch/profile_kernel.py
+(do NOT set PYTHONPATH — it breaks the axon TPU plugin discovery)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.conflict import tpu_index as TI
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from bench import make_batches
+
+print("devices:", jax.devices(), flush=True)
+
+TXNS = 2500
+WINDOW = 50
+P = 1 << 17
+L = 8
+NLIVE = 131072  # steady-state boundary count from round-1 bench
+
+# Synthetic steady-state index: NLIVE sorted random boundaries, random vers.
+rng = np.random.default_rng(0)
+raw = rng.integers(0, 2**32, size=(NLIVE, L), dtype=np.uint32)
+raw[NLIVE - 1] = 0xFFFFFFFF
+order = np.lexsort(tuple(raw[:, i] for i in reversed(range(L))))
+bounds = np.full((P, L), 0xFFFFFFFF, dtype=np.uint32)
+bounds[:NLIVE] = raw[order]
+bounds[0] = 0
+vers = np.zeros(P, np.int32)
+vers[:NLIVE] = rng.integers(1, 50, size=NLIVE)
+state = TI.IndexState(
+    bounds=jnp.asarray(bounds),
+    vers=jnp.asarray(vers),
+    tree=TI.build_tree(jnp.asarray(vers)),
+    n=jnp.int32(NLIVE),
+)
+jax.block_until_ready(state)
+
+cs = TpuConflictSet(capacity=P)
+txs = make_batches(1, TXNS)[0]
+b0, num_txns = cs._encode(txs)
+batch = jax.device_put(b0)
+jax.block_until_ready(batch)
+print("shapes: P", state.bounds.shape, "R", batch.rb.shape, "W", batch.wb.shape,
+      "T", num_txns, flush=True)
+
+
+def timeit(name, fn, *args, n=10):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:32s} {dt*1e3:9.3f} ms   (compile {compile_dt:.1f}s)", flush=True)
+    return out
+
+
+hist = jax.jit(functools.partial(TI.history_conflicts, num_txns=num_txns))
+H = timeit("history_conflicts", hist, state, batch)
+
+intra = jax.jit(functools.partial(TI.intra_batch_commits, num_txns=num_txns))
+commit = timeit("intra_batch_commits", intra, batch, H)
+
+merge = jax.jit(TI.merge_writes)
+now = jnp.int32(60)
+old = jnp.int32(10)
+timeit("merge_writes", merge, state, batch, commit, now, old)
+
+bt = jax.jit(TI.build_tree)
+timeit("build_tree(P)", bt, state.vers)
+
+W_ = batch.wb.shape[0]
+R_ = batch.rb.shape[0]
+
+
+@jax.jit
+def intra_parts(batch, H):
+    T = num_txns
+    W = batch.wb.shape[0]
+    w_active = TI.lex_lt(batch.wb, batch.we)
+    r_active = TI.lex_lt(batch.rb, batch.re)
+    pts = TI._lex_sort_rows(jnp.concatenate([batch.wb, batch.we], axis=0))
+    wb_g = TI._searchsorted(pts, batch.wb, "right")
+    we_g = TI._searchsorted(pts, batch.we, "left")
+    ra_g = TI._searchsorted(pts, batch.rb, "right")
+    rb_g = TI._searchsorted(pts, batch.re, "left")
+    return w_active, r_active, wb_g, we_g, ra_g, rb_g
+
+
+parts = timeit("intra: sort+4 searchsorted(2W)", intra_parts, batch, H)
+w_active, r_active, wb_g, we_g, ra_g, rb_g = parts
+
+
+@jax.jit
+def intra_cover(batch, w_active, wb_g, we_g):
+    T = num_txns
+    W = batch.wb.shape[0]
+    diff = jnp.zeros((2 * W + 2, T), dtype=jnp.int32)
+    one = jnp.where(w_active, 1, 0).astype(jnp.int32)
+    diff = diff.at[wb_g, batch.w_owner].add(one, mode="drop")
+    diff = diff.at[we_g + 1, batch.w_owner].add(-one, mode="drop")
+    covered = jnp.cumsum(diff, axis=0)[:-1] > 0
+    S = jnp.concatenate([jnp.zeros((1, T), jnp.int32),
+                         jnp.cumsum(covered.astype(jnp.int32), axis=0)])
+    return S
+
+
+S = timeit("intra: scatter+cumsum [2W,T]", intra_cover, batch, w_active, wb_g, we_g)
+
+
+@jax.jit
+def intra_fix(batch, S, r_active, ra_g, rb_g, H):
+    T = num_txns
+    overlap = (S[rb_g + 1] - S[ra_g]) > 0
+    overlap = overlap & r_active[:, None]
+    Pji = jnp.zeros((T, T), dtype=bool)
+    Pji = Pji.at[batch.r_owner].max(overlap, mode="drop")
+    earlier = jnp.arange(T)[None, :] < jnp.arange(T)[:, None]
+    Pji = Pji & earlier
+
+    def body(val):
+        commit, _ = val
+        blocked = (Pji & commit[None, :]).any(axis=1)
+        new = ~H & ~blocked
+        return new, jnp.any(new != commit)
+
+    commit, _ = jax.lax.while_loop(lambda v: v[1], body, (~H, jnp.array(True)))
+    return commit
+
+
+timeit("intra: overlap+Pji+fixpoint", intra_fix, batch, S, r_active, ra_g, rb_g, H)
+
+
+@jax.jit
+def hist_search(state, batch):
+    lo = TI._searchsorted(state.bounds, batch.rb, "right") - 1
+    hi = TI._searchsorted(state.bounds, batch.re, "left") - 1
+    return lo, hi
+
+
+lo, hi = timeit("hist: 2x searchsorted(P)", hist_search, state, batch)
+
+
+@jax.jit
+def hist_rmax(state, lo, hi):
+    return TI.range_max(state.tree, jnp.maximum(lo, 0), hi)
+
+
+timeit("hist: range_max", hist_rmax, state, lo, hi)
+
+
+@jax.jit
+def merge_scatter(state, C):
+    P, L = state.bounds.shape
+    W = C.shape[0] // 2
+    M = P + 2 * W
+    A = state.bounds
+    a_j = TI._searchsorted(A, C, "right")
+    posC = jnp.arange(2 * W, dtype=jnp.int32) + a_j
+    hist = jnp.zeros((P + 1,), jnp.int32).at[a_j].add(1)
+    posA = jnp.arange(P, dtype=jnp.int32) + jnp.cumsum(hist)[:P]
+    D0 = jnp.full((M, L), TI.SENTINEL, dtype=jnp.uint32)
+    D0 = D0.at[posA].set(A)
+    D0 = D0.at[posC].set(C)
+    return D0
+
+
+C = TI._lex_sort_rows(jnp.concatenate([batch.wb, batch.we], axis=0))
+D0 = timeit("merge: row-scatter into M", merge_scatter, state, C)
+
+
+@jax.jit
+def merge_runs(D0):
+    M = D0.shape[0]
+    prev_differs = jnp.concatenate([jnp.ones((1,), bool), (D0[1:] != D0[:-1]).any(axis=1)])
+    run_id = jnp.cumsum(prev_differs.astype(jnp.int32)) - 1
+    starts = jnp.full((M + 1,), M, jnp.int32)
+    starts = starts.at[run_id].min(jnp.arange(M, dtype=jnp.int32))
+    next_start = starts[run_id + 1]
+    return next_start
+
+
+timeit("merge: run-id pass (M)", merge_runs, D0)

@@ -1,0 +1,227 @@
+"""Primitive-cost measurements on the v5e to drive the kernel redesign.
+
+Run: python scratch/profile_prims.py  (no PYTHONPATH)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print("devices:", jax.devices(), flush=True)
+rng = np.random.default_rng(0)
+
+
+def timeit(name, fn, *args, n=10):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:44s} {dt*1e3:9.3f} ms  (compile {c:.1f}s)", flush=True)
+    return out
+
+
+L = 3
+P = 131072
+Q = 8192
+
+base = jnp.asarray(np.sort(rng.integers(0, 2**31, (P,), dtype=np.int32)))
+base3 = jnp.asarray(rng.integers(0, 2**31, (P, L), dtype=np.int32))
+vers = jnp.asarray(rng.integers(1, 50, (P,), dtype=np.int32))
+q1 = jnp.asarray(rng.integers(0, 2**31, (Q,), dtype=np.int32))
+q3 = jnp.asarray(rng.integers(0, 2**31, (Q, L), dtype=np.int32))
+idxQ = jnp.asarray(rng.integers(0, P, (Q,), dtype=np.int32))
+idxP = jnp.asarray(rng.integers(0, P, (P,), dtype=np.int32))
+
+# 1. sorts
+def mk_sort(n, cols):
+    data = [jnp.asarray(rng.integers(0, 2**31, (n,), dtype=np.int32)) for _ in range(cols)]
+
+    @jax.jit
+    def f(*d):
+        return jax.lax.sort(d, num_keys=min(3, cols))
+
+    return f, data
+
+
+for n in (8192, 16384, 131072, 262144, 524288):
+    f, data = mk_sort(n, 5)
+    timeit(f"sort n={n} cols=5 keys=3", f, *data)
+
+# 2. row gathers
+@jax.jit
+def row_gather_q(a, idx):
+    return a[idx]
+
+
+timeit("row gather 8192 rows from [131072,3]", row_gather_q, base3, idxQ)
+timeit("row gather 131072 rows from [131072,3]", row_gather_q, base3, idxP)
+
+# 3. contiguous block gather (dynamic_slice in vmap / gather w/ slice sizes)
+@jax.jit
+def block_gather(a, starts):
+    # [Q, 32] contiguous slices from 1-D array
+    return jax.vmap(lambda s: jax.lax.dynamic_slice(a, (s,), (32,)))(starts)
+
+
+timeit("block gather 8192 x 32 contiguous (1D)", block_gather, base, idxQ)
+
+# 4. 1-D gathers
+@jax.jit
+def g1(a, idx):
+    return a[idx]
+
+
+timeit("1D gather 8192 from [131072]", g1, vers, idxQ)
+timeit("1D gather 131072 from [131072]", g1, vers, idxP)
+
+# 5. 1-D scatter-add
+@jax.jit
+def sc_add(idx):
+    return jnp.zeros((P,), jnp.int32).at[idx].add(1)
+
+
+timeit("1D scatter-add 8192 into [131072]", sc_add, idxQ)
+
+
+@jax.jit
+def sc_add_sorted(idx):
+    return jnp.zeros((P,), jnp.int32).at[idx].add(1, unique_indices=False, indices_are_sorted=True)
+
+
+timeit("1D scatter-add 8192 sorted-idx", sc_add_sorted, jnp.sort(idxQ))
+
+# 6. row scatter
+@jax.jit
+def row_scatter(q, idx):
+    return jnp.zeros((P + Q, L), jnp.int32).at[idx].set(q)
+
+
+timeit("row scatter 8192x3 into [139264,3]", row_scatter, q3, idxQ)
+
+# 7. cumsums
+@jax.jit
+def cs(a):
+    return jnp.cumsum(a)
+
+
+timeit("cumsum [131072]", cs, vers)
+big = jnp.asarray(rng.integers(0, 100, (524288,), dtype=np.int32))
+timeit("cumsum [524288]", cs, big)
+
+# 8. dense compare RxW 3-lane lex + reduce
+w3 = jnp.asarray(rng.integers(0, 2**31, (4096, L), dtype=np.int32))
+r3 = jnp.asarray(rng.integers(0, 2**31, (4096, L), dtype=np.int32))
+
+
+@jax.jit
+def dense_lex(r, w):
+    # lex r < w over trailing lane, dense [4096, 4096]
+    lt = jnp.zeros((4096, 4096), bool)
+    eq = jnp.ones((4096, 4096), bool)
+    for i in range(L):
+        ri = r[:, None, i]
+        wi = w[None, :, i]
+        lt = lt | (eq & (ri < wi))
+        eq = eq & (ri == wi)
+    return lt.any(axis=1)
+
+
+timeit("dense lex cmp [4096x4096x3] + reduce", dense_lex, r3, w3)
+
+# 9/10. MXU fixpoint
+Pji = jnp.asarray(rng.random((4096, 4096)) < 0.001, dtype=jnp.bfloat16)
+H = jnp.asarray(rng.random((4096,)) < 0.3)
+
+
+@jax.jit
+def fixpoint(Pji, H):
+    def body(val):
+        commit, _ = val
+        blocked = (Pji @ commit.astype(jnp.bfloat16)) > 0
+        new = ~H & ~blocked
+        return new, jnp.any(new != commit)
+
+    commit, _ = jax.lax.while_loop(lambda v: v[1], body, (~H, jnp.array(True)))
+    return commit
+
+
+timeit("MXU bf16 matvec fixpoint [4096^2]", fixpoint, Pji, H)
+
+# 11. binary search: 18 rounds, 8192 queries, 3-lane rows
+def lex_lt(a, b):
+    lt = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    eq = jnp.ones_like(lt)
+    for i in range(a.shape[-1]):
+        ai, bi = a[..., i], b[..., i]
+        lt = lt | (eq & (ai < bi))
+        eq = eq & (ai == bi)
+    return lt
+
+
+@jax.jit
+def bsearch(sorted3, q):
+    lo = jnp.zeros(q.shape[:-1], jnp.int32)
+    hi = jnp.full(q.shape[:-1], P, jnp.int32)
+    for _ in range(18):
+        mid = (lo + hi) >> 1
+        row = sorted3[mid]
+        go = lex_lt(row, q)
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where(go, hi, mid)
+    return lo
+
+
+timeit("binary search 8192 q into [131072,3] x18", bsearch, base3, q3)
+
+# 12. one-hot matmul positioning: rank of q among 4096 pivots via MXU-able compare
+piv = jnp.asarray(np.sort(rng.integers(0, 2**31, (4096,), dtype=np.int32)))
+
+
+@jax.jit
+def rank_dense(q, piv):
+    return (q[:, None] >= piv[None, :]).sum(axis=1)
+
+
+timeit("dense rank 8192 q vs 4096 pivots (1 lane)", rank_dense, q1, piv)
+
+# 13. sparse-table 2-gather range max
+st = jnp.asarray(rng.integers(1, 50, (18, P), dtype=np.int32))
+lo_i = jnp.asarray(rng.integers(0, P - 1, (Q,), dtype=np.int32))
+ln = jnp.asarray(rng.integers(1, 1000, (Q,), dtype=np.int32))
+
+
+@jax.jit
+def st_rmax(st, lo, ln):
+    k = 31 - jax.lax.clz(ln)  # floor log2
+    hi = lo + ln - (1 << k)
+    a = st[k, lo]
+    b = st[k, hi]
+    return jnp.maximum(a, b)
+
+
+timeit("sparse-table rmax 8192 q (2x 2D gather)", st_rmax, st, lo_i, ln)
+
+# 14. sort payload columns count effect
+f, data = mk_sort(139264, 3)
+timeit("sort n=139264 cols=3 keys=3", f, *data)
+f, data = mk_sort(139264, 6)
+timeit("sort n=139264 cols=6 keys=3", f, *data)
+
+# 15. segment-max via sorted-order cummax variant: associative_scan max over [524288]
+@jax.jit
+def cmax(a):
+    return jax.lax.cummax(a)
+
+
+timeit("cummax [524288]", cmax, big)

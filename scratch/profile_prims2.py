@@ -1,0 +1,255 @@
+"""Primitive costs for the bucket-grid conflict-index design.
+
+Run: python scratch/profile_prims2.py  (no PYTHONPATH)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print("devices:", jax.devices(), flush=True)
+rng = np.random.default_rng(0)
+
+
+def timeit(name, fn, *args, n=10):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:46s} {dt*1e3:9.3f} ms  (compile {c:.1f}s)", flush=True)
+    return out
+
+
+L = 3
+B = 2048  # buckets
+S = 96    # slots per bucket
+Q = 8192  # query endpoints per batch
+W = 8192  # write endpoints per batch
+T = 2560  # txns
+
+buckets = jnp.asarray(rng.integers(0, 2**31, (B, S, L + 1), dtype=np.int32))
+qb = jnp.asarray(rng.integers(0, B, (Q,), dtype=np.int32))
+q3 = jnp.asarray(rng.integers(0, 2**31, (Q, L), dtype=np.int32))
+pivots = jnp.asarray(np.sort(rng.integers(0, 2**31, (B,), dtype=np.int32)))
+pivots3 = jnp.asarray(rng.integers(0, 2**31, (B, L), dtype=np.int32))
+
+
+# 1. block gather: per query, one bucket's [S, L+1] block
+@jax.jit
+def block_gather(buckets, qb):
+    return buckets[qb]  # [Q, S, L+1]
+
+
+g = timeit(f"block gather [{Q},{S},{L+1}] buckets", block_gather, buckets, qb)
+
+
+# 2. two-level dense rank: 64 super + 64 within (here flat B for simplicity)
+@jax.jit
+def dense_rank_flat(q3, pivots3):
+    # lex q >= pivot, counted — [Q, B] compares, L lanes
+    ge = jnp.zeros((Q, B), bool)
+    eq = jnp.ones((Q, B), bool)
+    for i in range(L):
+        qi = q3[:, None, i]
+        pi = pivots3[None, :, i]
+        ge = ge | (eq & (qi > pi))
+        eq = eq & (qi == pi)
+    return (ge | eq).sum(axis=1, dtype=jnp.int32)
+
+
+timeit(f"dense lex rank [{Q}x{B}] flat", dense_rank_flat, q3, pivots3)
+
+
+@jax.jit
+def dense_rank_2level(q3, pivots3):
+    sup = pivots3[:: B // 64]  # [64, L]
+    def rank_vs(qv, pv):
+        ge = jnp.zeros(qv.shape[:1] + pv.shape[:1], bool)
+        eq = jnp.ones_like(ge)
+        for i in range(L):
+            qi = qv[:, None, i]
+            pi = pv[None, :, i]
+            ge = ge | (eq & (qi > pi))
+            eq = eq & (qi == pi)
+        return (ge | eq).sum(axis=1, dtype=jnp.int32)
+
+    hi = jnp.maximum(rank_vs(q3, sup) - 1, 0)  # [Q] super bucket
+    sub = pivots3.reshape(64, B // 64, L)[hi]  # [Q, 32, L] block gather
+    ge = jnp.zeros((Q, B // 64), bool)
+    eq = jnp.ones_like(ge)
+    for i in range(L):
+        qi = q3[:, None, i]
+        pi = sub[:, :, i]
+        ge = ge | (eq & (qi > pi))
+        eq = eq & (qi == pi)
+    lo = (ge | eq).sum(axis=1, dtype=jnp.int32)
+    return hi * (B // 64) + jnp.maximum(lo - 1, 0)
+
+
+timeit("dense lex rank 2-level (64+32)", dense_rank_2level, q3, pivots3)
+
+
+# 3. masked range-max over gathered windows [Q, S]
+@jax.jit
+def window_max(g, q3):
+    bounds = g[..., :L]  # [Q, S, L]
+    vers = g[..., L]
+    a = q3[:, None, :]
+    gt = jnp.zeros((Q, S), bool)
+    eq = jnp.ones((Q, S), bool)
+    for i in range(L):
+        bi = bounds[:, :, i]
+        ai = a[:, :, i]
+        gt = gt | (eq & (bi > ai))
+        eq = eq & (bi == ai)
+    mask = gt
+    return jnp.max(jnp.where(mask, vers, 0), axis=1)
+
+
+timeit(f"masked window max [{Q}x{S}]", window_max, g, q3)
+
+
+# 4. bucket-interval dense max: [Q, B] mask of buckets strictly between
+bmax = jnp.asarray(rng.integers(0, 50, (B,), dtype=np.int32))
+lo_b = jnp.asarray(rng.integers(0, B - 1, (Q,), dtype=np.int32))
+hi_b = jnp.asarray(np.minimum(rng.integers(0, B, (Q,)), B - 1).astype(np.int32))
+
+
+@jax.jit
+def bucket_between_max(bmax, lo_b, hi_b):
+    ar = jnp.arange(B, dtype=jnp.int32)[None, :]
+    mask = (ar > lo_b[:, None]) & (ar < hi_b[:, None])
+    return jnp.max(jnp.where(mask, bmax[None, :], 0), axis=1)
+
+
+timeit(f"bucket between-max [{Q}x{B}]", bucket_between_max, bmax, lo_b, hi_b)
+
+
+# 5. per-bucket vmapped bitonic sort: [B, S+D, L+1] rows, sort by 3 lanes
+D = 32
+staged = jnp.asarray(
+    rng.integers(0, 2**31, (B, S + D, L + 1), dtype=np.int32)
+)
+
+
+@jax.jit
+def bucket_sort(staged):
+    cols = tuple(staged[..., i] for i in range(L + 1))
+    out = jax.lax.sort(cols, dimension=1, num_keys=L)
+    return jnp.stack(out, axis=-1)
+
+
+timeit(f"per-bucket sort [{B},{S+D},{L+1}] dim=1", bucket_sort, staged)
+
+
+# 6. scatter 8K rows into [B, S+D] staging at computed (bucket, slot)
+wrows = jnp.asarray(rng.integers(0, 2**31, (W, L + 1), dtype=np.int32))
+wbkt = jnp.asarray(rng.integers(0, B, (W,), dtype=np.int32))
+wslot = jnp.asarray(rng.integers(0, D, (W,), dtype=np.int32))
+
+
+@jax.jit
+def scatter_stage(wrows, wbkt, wslot):
+    st = jnp.zeros((B, D, L + 1), jnp.int32)
+    return st.at[wbkt, wslot].set(wrows, mode="drop")
+
+
+timeit(f"2D row scatter {W} into [{B},{D}]", scatter_stage, wrows, wbkt, wslot)
+
+
+# 6b. flat 1D row scatter equivalent
+@jax.jit
+def scatter_flat(wrows, wbkt, wslot):
+    st = jnp.zeros((B * D, L + 1), jnp.int32)
+    return st.at[wbkt * D + wslot].set(wrows, mode="drop")
+
+
+timeit(f"flat row scatter {W} into [{B*D}]", scatter_flat, wrows, wbkt, wslot)
+
+
+# 7. global bitonic of batch endpoints [8192, 5 cols]
+cols = [jnp.asarray(rng.integers(0, 2**31, (W,), dtype=np.int32)) for _ in range(5)]
+
+
+@jax.jit
+def sort_batch(*cols):
+    return jax.lax.sort(cols, num_keys=4)
+
+
+timeit("sort 8192 x 5cols (4 keys)", sort_batch, *cols)
+
+
+# 8. dense padded overlap [T,1] vs [T,1] -> Pji + MXU fixpoint
+ra = jnp.asarray(rng.integers(0, 2**31, (T, L), dtype=np.int32))
+rb = ra + 10
+wa = jnp.asarray(rng.integers(0, 2**31, (T, L), dtype=np.int32))
+wb = wa + 10
+H = jnp.asarray(rng.random(T) < 0.3)
+
+
+@jax.jit
+def intra_dense(ra, rb, wa, wb, H):
+    def lex_lt(x, y):  # [T,1,L] vs [1,T,L] -> [T,T]
+        lt = jnp.zeros((T, T), bool)
+        eq = jnp.ones((T, T), bool)
+        for i in range(L):
+            xi = x[:, None, i]
+            yi = y[None, :, i]
+            lt = lt | (eq & (xi < yi))
+            eq = eq & (xi == yi)
+        return lt
+
+    Pji = lex_lt(ra, wb) & lex_lt(wa, rb)  # read j overlaps write i
+    earlier = jnp.arange(T)[None, :] < jnp.arange(T)[:, None]
+    Pf = (Pji & earlier).astype(jnp.bfloat16)
+
+    def body(val):
+        commit, _ = val
+        blocked = (Pf @ commit.astype(jnp.bfloat16)) > 0
+        new = ~H & ~blocked
+        return new, jnp.any(new != commit)
+
+    commit, _ = jax.lax.while_loop(lambda v: v[1], body, (~H, jnp.array(True)))
+    return commit
+
+
+timeit(f"intra dense overlap+MXU fixpoint [T={T}]", intra_dense, ra, rb, wa, wb, H)
+
+
+# 9. segment positions: per-bucket slot of sorted writes (run-position)
+sb = jnp.sort(wbkt)
+
+
+@jax.jit
+def run_pos(sb):
+    idx = jnp.arange(W, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+    start_idx = jnp.where(is_start, idx, 0)
+    return idx - jax.lax.cummax(start_idx)
+
+
+timeit("run positions (cummax) [8192]", run_pos, sb)
+
+# 10. full-image dense passes over the grid [B, S] (version GC etc.)
+vers_grid = jnp.asarray(rng.integers(0, 50, (B, S + D), dtype=np.int32))
+
+
+@jax.jit
+def grid_pass(v):
+    v = jnp.where(v < 10, 0, v)
+    return jax.lax.cummax(v, axis=1)
+
+
+timeit(f"grid cummax pass [{B},{S+D}]", grid_pass, vers_grid)

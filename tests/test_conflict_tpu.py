@@ -176,3 +176,26 @@ def test_verdict_mix_under_contention():
             seen.add(v)
         version += 10
     assert seen == {Verdict.COMMITTED, Verdict.CONFLICT, Verdict.TOO_OLD}
+
+
+def test_hot_key_batch_exceeding_slot_capacity():
+    """A batch where every transaction writes the SAME key must not
+    overflow the grid (staged rows aggregate per distinct boundary —
+    repivoting could never split equal codes across buckets)."""
+    tpu = new_conflict_set("tpu", capacity=1 << 8)  # S=32 slots
+    oracle = new_conflict_set("oracle")
+    point = [(b"counter", b"counter\x00")]
+    txs = [
+        CommitTransaction(read_snapshot=0, write_conflict_ranges=list(point))
+        for _ in range(40)
+    ]
+    assert tpu.detect_batch(txs, 10, 0) == oracle.detect_batch(txs, 10, 0)
+    rw = [
+        CommitTransaction(
+            read_snapshot=5,
+            read_conflict_ranges=list(point),
+            write_conflict_ranges=list(point),
+        )
+        for _ in range(40)
+    ]
+    assert tpu.detect_batch(rw, 20, 0) == oracle.detect_batch(rw, 20, 0)
