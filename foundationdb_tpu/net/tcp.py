@@ -1,0 +1,431 @@
+"""Real TCP transport: the non-simulated network personality.
+
+The analog of fdbrpc/FlowTransport.actor.cpp: token-addressed endpoints,
+length+CRC framed messages (net/wire.py), a protocol-version handshake on
+connect, automatic reconnection, and BrokenPromise semantics for requests
+to dead peers — behind the exact ``request()``/``register()`` surface of
+the simulator (net/sim.py), so every role runs unmodified as a real OS
+process.
+
+Topology objects:
+
+- ``RealWorld`` — one OS process's view of the cluster. Mirrors ``Sim``'s
+  surface (``knobs``, ``loop``, ``disk()``, ``processes``/``new_process``)
+  so code written against the simulator runs over TCP untouched.
+- ``RealNode`` — the local process (mirrors ``SimProcess``): registers
+  endpoint handlers, originates requests. One listener per process;
+  request/reply frames multiplex over one connection per peer.
+
+Failure semantics match the sim: a request to an unreachable/reset peer
+errors with BrokenPromise (flow's broken_promise); callers retry through
+their existing failover paths. Errors raised by remote handlers propagate
+with their FdbError code; everything else surfaces as RemoteError.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Optional
+
+from ..errors import FdbError
+from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
+from ..runtime.knobs import Knobs
+from ..runtime.loop import RealLoop, TaskPriority, set_loop
+from ..runtime.trace import SevInfo, SevWarn, trace
+from . import wire
+from .sim import BrokenPromise, Endpoint
+
+
+class RemoteError(Exception):
+    """A remote handler raised a non-FdbError exception."""
+
+
+class _Conn:
+    """One TCP connection (either direction) with framing + dispatch."""
+
+    def __init__(self, world: "RealWorld", sock: socket.socket, peer: Optional[str]):
+        self.world = world
+        self.sock = sock
+        self.peer = peer  # peer's listen address (None until handshake)
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.closed = False
+        self.handshaken = peer is not None and False  # always expect preamble
+        sock.setblocking(False)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        world.loop.add_reader(sock, self._on_readable)
+
+    def send(self, msg: Any) -> None:
+        if self.closed:
+            return
+        frame = wire.encode_frame(wire.encode_value(msg))
+        first = not self.outbuf
+        self.outbuf += frame
+        if first:
+            self._on_writable()  # opportunistic immediate write
+            if self.outbuf and not self.closed:
+                self.world.loop.add_writer(self.sock, self._on_writable)
+
+    def _on_writable(self) -> None:
+        try:
+            while self.outbuf:
+                n = self.sock.send(self.outbuf)
+                if n <= 0:
+                    break
+                del self.outbuf[:n]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self.close()
+            return
+        if not self.outbuf:
+            self.world.loop.remove_writer(self.sock)
+
+    def _on_readable(self) -> None:
+        try:
+            data = self.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self.close()
+            return
+        if not data:
+            self.close()
+            return
+        self.inbuf += data
+        try:
+            if not self.handshaken:
+                hs = wire.parse_handshake(self.inbuf)
+                if hs is None:
+                    return
+                addr, consumed = hs
+                del self.inbuf[:consumed]
+                self.handshaken = True
+                if self.peer is None:
+                    self.peer = addr
+                self.world._conn_ready(self)
+            for payload in wire.decode_frames(self.inbuf):
+                self.world._on_message(self, wire.decode_value(payload))
+        except wire.WireError as e:
+            trace(SevWarn, "WireError", self.world.node.address, Err=str(e))
+            self.close()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.world.loop.remove_reader(self.sock)
+        self.world.loop.remove_writer(self.sock)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.world._conn_closed(self)
+
+
+class RealNode:
+    """The local process — SimProcess-compatible surface."""
+
+    def __init__(self, world: "RealWorld", address: str):
+        self.world = world
+        self.sim = world  # roles access knobs/disk/loop through .sim
+        self.address = address
+        self.machine = address
+        self.endpoints: dict[str, Callable] = {}
+        self.actors = ActorCollection()
+        self.alive = True
+
+    def register(self, token: str, handler: Callable) -> Endpoint:
+        self.endpoints[token] = handler
+        return Endpoint(self.address, token)
+
+    def spawn(self, coro, priority: int = TaskPriority.DEFAULT) -> Future:
+        fut = spawn(coro, priority)
+        self.actors.add(fut)
+        return fut
+
+    def request(self, ep: Endpoint, payload: Any) -> Future:
+        return self.world.request(ep, payload)
+
+
+class RealWorld:
+    """One OS process's cluster world over TCP (Sim-compatible surface)."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        knobs: Optional[Knobs] = None,
+        data_dir: Optional[str] = None,
+        loop: Optional[RealLoop] = None,
+        seed: Optional[int] = None,
+    ):
+        self.loop = loop or RealLoop(seed)
+        self.knobs = knobs or Knobs()
+        self.data_dir = data_dir
+        self.node = RealNode(self, listen_addr)
+        # Sim-surface compatibility (Database, roles):
+        self.processes = {listen_addr: self.node}
+        self._disks: dict[str, Any] = {}
+        self._conns: dict[str, _Conn] = {}  # peer listen addr → live conn
+        self._connecting: dict[str, Future] = {}
+        self._anon: list[_Conn] = []  # accepted, pre-handshake
+        self._pending: dict[int, tuple[Future, str]] = {}  # id → (fut, peer)
+        self._next_id = 1
+        self._listener: Optional[socket.socket] = None
+        self._listen()
+
+    # -- Sim-compatible world surface -----------------------------------------
+
+    def new_process(self, address: str, machine: str = None, boot=None) -> RealNode:
+        """A real OS process hosts exactly one node; Database asks for a
+        'client' process and gets the local one."""
+        return self.node
+
+    def disk(self, machine: str):
+        from .files import RealDisk
+
+        d = self._disks.get(machine)
+        if d is None:
+            import os
+
+            root = self.data_dir or "fdbtpu-data"
+            d = self._disks[machine] = RealDisk(os.path.join(root, machine))
+        return d
+
+    def activate(self) -> None:
+        set_loop(self.loop)
+
+    def run(self, until: float = float("inf"), stop_when=None) -> float:
+        self.activate()
+        return self.loop.run(until, stop_when)
+
+    def run_until_done(self, fut: Future, limit: float = 1e9) -> Any:
+        self.activate()
+        t0 = self.loop.now()
+        self.loop.run(until=t0 + limit, stop_when=fut.is_ready)
+        if not fut.is_ready():
+            raise TimeoutError(f"did not finish within {limit}s")
+        return fut.get()
+
+    def close(self) -> None:
+        if self._listener is not None:
+            self.loop.remove_reader(self._listener)
+            self._listener.close()
+            self._listener = None
+        for c in list(self._conns.values()) + list(self._anon):
+            c.close()
+
+    # -- listening -------------------------------------------------------------
+
+    def _listen(self) -> None:
+        host, port = self.node.address.rsplit(":", 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, int(port)))
+        s.listen(128)
+        s.setblocking(False)
+        self._listener = s
+        self.loop.add_reader(s, self._on_accept)
+        trace(SevInfo, "TransportListening", self.node.address)
+
+    def _on_accept(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            conn = _Conn(self, sock, None)
+            conn.outbuf += wire.handshake_bytes(self.node.address)
+            conn._on_writable()
+            if conn.outbuf and not conn.closed:
+                self.loop.add_writer(sock, conn._on_writable)
+            if not conn.closed:
+                self._anon.append(conn)
+
+    # -- connections -----------------------------------------------------------
+
+    def _conn_ready(self, conn: _Conn) -> None:
+        if conn in self._anon:
+            self._anon.remove(conn)
+        # simultaneous connect: the newest handshaken connection wins the
+        # routing slot; a displaced one still drains its in-flight replies
+        # until either side closes it
+        self._conns[conn.peer] = conn
+        waiter = self._connecting.pop(conn.peer, None)
+        if waiter is not None and not waiter.is_ready():
+            waiter._set(None)
+
+    def _conn_closed(self, conn: _Conn) -> None:
+        if conn in self._anon:
+            self._anon.remove(conn)
+        if conn.peer is not None and self._conns.get(conn.peer) is conn:
+            del self._conns[conn.peer]
+        # fail requests that were in flight on this connection
+        dead = [
+            (rid, fut)
+            for rid, (fut, peer) in self._pending.items()
+            if peer == conn.peer
+        ]
+        for rid, fut in dead:
+            self._pending.pop(rid, None)
+            if not fut.is_ready():
+                fut._set_error(BrokenPromise(f"connection to {conn.peer} lost"))
+        waiter = self._connecting.pop(conn.peer, None) if conn.peer else None
+        if waiter is not None and not waiter.is_ready():
+            waiter._set_error(BrokenPromise(f"connect to {conn.peer} failed"))
+
+    def _connect(self, peer: str) -> Future:
+        """Future resolving when a connection to ``peer`` is live."""
+        if peer in self._conns:
+            f = Future()
+            f._set(None)
+            return f
+        waiter = self._connecting.get(peer)
+        if waiter is not None:
+            return waiter
+        waiter = self._connecting[peer] = Future()
+        host, port = peer.rsplit(":", 1)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.connect((host, int(port)))
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError as e:
+            sock.close()
+            self._connecting.pop(peer, None)
+            waiter._set_error(BrokenPromise(f"connect {peer}: {e}"))
+            return waiter
+
+        conn = _Conn(self, sock, peer)
+
+        def on_connected():
+            if conn.closed:
+                return  # read side already saw the failure in this batch
+            self.loop.remove_writer(sock)
+            err = sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err:
+                conn.close()
+                return
+            try:
+                conn.outbuf += wire.handshake_bytes(self.node.address)
+                conn._on_writable()
+                if conn.outbuf:
+                    self.loop.add_writer(sock, conn._on_writable)
+            except OSError:
+                conn.close()
+
+        self.loop.add_writer(sock, on_connected)
+        return waiter
+
+    # -- RPC -------------------------------------------------------------------
+
+    def request(self, ep: Endpoint, payload: Any) -> Future:
+        reply: Future = Future()
+        if ep.address == self.node.address:
+            self._dispatch_local(ep.token, payload, reply)
+            return reply
+        rid = self._next_id
+        self._next_id += 1
+        msg = ("req", rid, ep.token, payload)
+        conn = self._conns.get(ep.address)
+        if conn is not None:
+            self._pending[rid] = (reply, ep.address)
+            conn.send(msg)
+            return reply
+
+        waiter = self._connect(ep.address)
+
+        def on_conn():
+            if waiter.is_error():
+                if not reply.is_ready():
+                    reply._set_error(waiter._error)
+                return
+            c = self._conns.get(ep.address)
+            if c is None:
+                if not reply.is_ready():
+                    reply._set_error(BrokenPromise(f"no route to {ep.address}"))
+                return
+            self._pending[rid] = (reply, ep.address)
+            c.send(msg)
+
+        waiter.add_callback(lambda _f: on_conn())
+        return reply
+
+    def _dispatch_local(self, token: str, payload, reply: Future) -> None:
+        handler = self.node.endpoints.get(token)
+        if handler is None:
+            reply._set_error(BrokenPromise(f"{self.node.address}:{token}"))
+            return
+
+        async def run_and_reply():
+            try:
+                result = await handler(payload)
+            except Cancelled:
+                if not reply.is_ready():
+                    reply._set_error(BrokenPromise(token))
+                return
+            except BaseException as e:
+                if not reply.is_ready():
+                    reply._set_error(e)
+                return
+            if not reply.is_ready():
+                reply._set(result)
+
+        self.node.spawn(run_and_reply())
+
+    def _on_message(self, conn: _Conn, msg) -> None:
+        kind = msg[0]
+        if kind == "req":
+            _k, rid, token, payload = msg
+            handler = self.node.endpoints.get(token)
+            if handler is None:
+                conn.send(("err", rid, "broken_promise", token))
+                return
+
+            async def run_and_reply(rid=rid, handler=handler, payload=payload):
+                try:
+                    result = await handler(payload)
+                except Cancelled:
+                    conn.send(("err", rid, "broken_promise", token))
+                    return
+                except FdbError as e:
+                    conn.send(("err", rid, "fdb", type(e).__name__))
+                    return
+                except BrokenPromise as e:
+                    conn.send(("err", rid, "broken_promise", str(e)))
+                    return
+                except BaseException as e:
+                    conn.send(("err", rid, "remote", repr(e)))
+                    return
+                conn.send(("ok", rid, result))
+
+            self.node.spawn(run_and_reply())
+        elif kind == "ok":
+            _k, rid, value = msg
+            ent = self._pending.pop(rid, None)
+            if ent is not None and not ent[0].is_ready():
+                ent[0]._set(value)
+        elif kind == "err":
+            _k, rid, etype, detail = msg
+            ent = self._pending.pop(rid, None)
+            if ent is None or ent[0].is_ready():
+                return
+            if etype == "fdb":
+                from .. import errors as _errors
+
+                cls = getattr(_errors, str(detail), FdbError)
+                if not (isinstance(cls, type) and issubclass(cls, FdbError)):
+                    cls = FdbError
+                ent[0]._set_error(cls(str(detail)))
+            elif etype == "broken_promise":
+                ent[0]._set_error(BrokenPromise(str(detail)))
+            else:
+                ent[0]._set_error(RemoteError(str(detail)))
+        else:
+            trace(SevWarn, "WireBadKind", self.node.address, Kind=str(kind))

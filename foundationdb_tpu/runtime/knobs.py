@@ -46,6 +46,14 @@ class Knobs:
                 raise KeyError(f"unknown knob {k!r}")
             setattr(self, k, v)
 
+    def as_dict(self) -> dict:
+        """Every knob value (for the wire codec and --knob tooling)."""
+        return {
+            k: getattr(self, k)
+            for k in dir(type(self))
+            if k.isupper() and not k.startswith("_")
+        }
+
     def randomize(self, rng) -> None:
         """Buggify-style knob randomization for simulation runs."""
         if rng.coinflip(0.25):

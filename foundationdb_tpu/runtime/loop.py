@@ -85,6 +85,115 @@ class EventLoop:
         return self._time
 
 
+class RealLoop(EventLoop):
+    """Wall-clock run loop with socket IO — the non-simulated personality
+    of the event loop (the reference's Net2 over boost.asio,
+    flow/Net2.actor.cpp:545 + AsioReactor: timers and socket readiness in
+    one reactor). The actor/future machinery is loop-agnostic, so server
+    code runs unmodified on either personality; only this loop may block
+    in ``select``.
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        import os as _os
+        import selectors
+
+        if seed is None:
+            seed = int.from_bytes(_os.urandom(8), "little")
+        super().__init__(seed)
+        self._selector = selectors.DefaultSelector()
+        self._t0 = self._monotonic()
+        self._time = 0.0
+
+    @staticmethod
+    def _monotonic() -> float:
+        import time as _time
+
+        return _time.monotonic()
+
+    def _wall(self) -> float:
+        return self._monotonic() - self._t0
+
+    # -- IO registration -------------------------------------------------------
+
+    def add_reader(self, sock, cb: Callable[[], None]) -> None:
+        import selectors
+
+        try:
+            key = self._selector.get_key(sock)
+        except KeyError:
+            self._selector.register(sock, selectors.EVENT_READ, [cb, None])
+            return
+        key.data[0] = cb
+        self._selector.modify(sock, key.events | selectors.EVENT_READ, key.data)
+
+    def add_writer(self, sock, cb: Callable[[], None]) -> None:
+        import selectors
+
+        try:
+            key = self._selector.get_key(sock)
+        except KeyError:
+            self._selector.register(sock, selectors.EVENT_WRITE, [None, cb])
+            return
+        key.data[1] = cb
+        self._selector.modify(sock, key.events | selectors.EVENT_WRITE, key.data)
+
+    def remove_reader(self, sock) -> None:
+        self._remove(sock, 0)
+
+    def remove_writer(self, sock) -> None:
+        self._remove(sock, 1)
+
+    def _remove(self, sock, slot: int) -> None:
+        import selectors
+
+        try:
+            key = self._selector.get_key(sock)
+        except (KeyError, ValueError):
+            return  # never registered, or already closed (fd -1)
+        key.data[slot] = None
+        events = (selectors.EVENT_READ if key.data[0] else 0) | (
+            selectors.EVENT_WRITE if key.data[1] else 0
+        )
+        if events:
+            self._selector.modify(sock, events, key.data)
+        else:
+            self._selector.unregister(sock)
+
+    # -- running ---------------------------------------------------------------
+
+    def run(self, until: float = float("inf"), stop_when: Callable[[], bool] = None):
+        import selectors
+
+        while not self.stopped:
+            self._time = self._wall()
+            # drain due callbacks
+            while self._queue and self._queue[0][0] <= self._time:
+                _w, _p, _s, fn = heapq.heappop(self._queue)
+                fn()
+                if stop_when is not None and stop_when():
+                    return self._time
+                self._time = self._wall()
+            if stop_when is not None and stop_when():
+                return self._time
+            if self._time >= until:
+                return self._time
+            if not self._queue and not self._selector.get_map():
+                return self._time  # nothing left to wait for
+            wait = 0.05
+            if self._queue:
+                wait = max(0.0, min(wait, self._queue[0][0] - self._time))
+            if until != float("inf"):
+                wait = max(0.0, min(wait, until - self._time))
+            for key, events in self._selector.select(wait):
+                rd, wr = key.data
+                if events & selectors.EVENT_READ and rd is not None:
+                    rd()
+                if events & selectors.EVENT_WRITE and wr is not None:
+                    wr()
+        return self._time
+
+
 _current: Optional[EventLoop] = None
 
 
