@@ -19,11 +19,19 @@ from __future__ import annotations
 from typing import Optional
 
 from ..net.sim import BrokenPromise, Endpoint, Sim
-from ..runtime.futures import AsyncVar, delay, settled, timeout, wait_for_any
+from ..runtime.futures import (
+    AsyncVar,
+    RequestBatcher,
+    delay,
+    settled,
+    timeout,
+    wait_for_any,
+)
 from ..runtime.knobs import Knobs
 from ..kv.keyrange_map import KeyRangeMap
 from ..server.interfaces import (
     GetKeyServersRequest,
+    GetReadVersionRequest,
     OpenDatabaseRequest,
     ProxyInterface,
     Tokens,
@@ -55,6 +63,8 @@ class Database:
         self._proxies: AsyncVar = AsyncVar(proxy_ifaces)
         # location cache: key range → team addresses (None = unknown)
         self._locations = KeyRangeMap(default=None)
+        # GRV batcher (readVersionBatcher, NativeAPI.actor.cpp:1290)
+        self._grv_batcher = RequestBatcher(self._fetch_grv, self.client.spawn)
         if coordinators:
             self.client.spawn(self._monitor_proxies(coordinators))
 
@@ -133,6 +143,17 @@ class Database:
                 )
         raise last_err
 
+    async def get_read_version(self) -> int:
+        """Batched GRV (the reference's readVersionBatcher,
+        NativeAPI.actor.cpp:1290): concurrent callers coalesce into one
+        proxy round trip — an idle client pays no added latency, a busy
+        one amortizes the RPC."""
+        return await self._grv_batcher.join()
+
+    async def _fetch_grv(self) -> int:
+        reply = await self._proxy_request(Tokens.GRV, GetReadVersionRequest())
+        return reply.version
+
     async def _locate(self, key: bytes):
         """(shard begin, end, team) for key, cached (NativeAPI:1059)."""
         cached = self._locations.range_for(key)
@@ -140,6 +161,19 @@ class Database:
             return cached
         reply = await self._proxy_request(
             Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+        )
+        self._locations.insert(reply.begin, reply.end, reply.team)
+        return reply.begin, reply.end, reply.team
+
+    async def _locate_before(self, key: bytes):
+        """(shard begin, end, team) for the keys immediately below ``key`` —
+        reverse range reads walk shards right-to-left from the range end
+        (NativeAPI getRange reverse handling)."""
+        cached = self._locations.range_before(key)
+        if cached[2] is not None:
+            return cached
+        reply = await self._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key, before=True)
         )
         self._locations.insert(reply.begin, reply.end, reply.team)
         return reply.begin, reply.end, reply.team

@@ -79,8 +79,8 @@ class Transaction:
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            reply = await self.db._proxy_request(Tokens.GRV, GetReadVersionRequest())
-            self._read_version = reply.version
+            # batched through the database's readVersionBatcher
+            self._read_version = await self.db.get_read_version()
         return self._read_version
 
     def set_read_version(self, version: int) -> None:
@@ -287,18 +287,20 @@ class Transaction:
         return reply.data, None
 
     async def _storage_window_rev(self, lo, hi, limit):
-        """One reverse storage fetch; next_hi bounds the next window."""
+        """One reverse storage fetch, walking shards right-to-left from
+        ``hi`` (NativeAPI's reverse getRange). next_hi bounds the next
+        window, or None when [lo, hi) is fully covered by this reply."""
         version = await self.get_read_version()
-        # single-shard reverse only until shard-aware backward iteration
-        # (stage 6 widens this)
-        _b, s_end, _team = await self.db._locate(lo)
-        assert s_end is None or s_end >= hi, "reverse range across shards: not yet"
+        s_begin, _s_end, _team = await self.db._locate_before(hi)
+        chunk_lo = max(lo, s_begin)
         req = GetKeyValuesRequest(
-            begin=lo, end=hi, version=version, limit=limit, reverse=True
+            begin=chunk_lo, end=hi, version=version, limit=limit, reverse=True
         )
-        reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
+        reply = await self._load_balanced(chunk_lo, Tokens.GET_KEY_VALUES, req)
         if reply.more:
             return reply.data, reply.data[-1][0]
+        if chunk_lo > lo:
+            return reply.data, chunk_lo
         return reply.data, None
 
     async def _load_balanced(self, key: bytes, token: str, req):

@@ -110,17 +110,46 @@ def lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
     return ~lex_lt(b, a)
 
 
+def _split_factors(n: int) -> tuple[int, int]:
+    """n = B1 * B2 with both powers of two, B1 >= B2 (n must be a power
+    of two)."""
+    lg = n.bit_length() - 1
+    b1 = 1 << ((lg + 1) // 2)
+    return b1, n // b1
+
+
 def _rank_le(points: jax.Array, pivots: jax.Array) -> jax.Array:
-    """#(pivots <= point) - 1 per point: dense [N, B] lex compare.
-    points [..., L], pivots [B, L] → int32[...]."""
-    le = lex_le(pivots[None, :, :], points[..., None, :])  # pivot <= point
-    return le.sum(axis=-1, dtype=jnp.int32) - 1
+    """#(pivots <= point) - 1 per point. points [N, L], pivots [B, L] →
+    int32[N]. Two-level: rank against B1 superpivots (every B2-th pivot),
+    then within the B2-pivot block — O(N·(B1+B2)) instead of O(N·B).
+    Exact because pivots are sorted: every pivot in a block below the
+    landing block is <= the landing block's superpivot <= point."""
+    B = pivots.shape[0]
+    B1, B2 = _split_factors(B)
+    if B2 == 1:
+        le = lex_le(pivots[None, :, :], points[:, None, :])
+        return le.sum(axis=-1, dtype=jnp.int32) - 1
+    pb = pivots.reshape(B1, B2, pivots.shape[-1])
+    sup = pb[:, 0, :]
+    s1 = lex_le(sup[None], points[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    blk = pb[jnp.maximum(s1, 0)]  # [N, B2, L] block gather
+    s2 = lex_le(blk, points[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    return s1 * B2 + s2
 
 
 def _rank_lt(points: jax.Array, pivots: jax.Array) -> jax.Array:
-    """#(pivots < point) - 1 per point (bucket of point⁻)."""
-    lt = lex_lt(pivots[None, :, :], points[..., None, :])
-    return lt.sum(axis=-1, dtype=jnp.int32) - 1
+    """#(pivots < point) - 1 per point (bucket of point⁻), two-level."""
+    B = pivots.shape[0]
+    B1, B2 = _split_factors(B)
+    if B2 == 1:
+        lt = lex_lt(pivots[None, :, :], points[:, None, :])
+        return lt.sum(axis=-1, dtype=jnp.int32) - 1
+    pb = pivots.reshape(B1, B2, pivots.shape[-1])
+    sup = pb[:, 0, :]
+    s1 = lex_lt(sup[None], points[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    blk = pb[jnp.maximum(s1, 0)]
+    s2 = lex_lt(blk, points[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    return s1 * B2 + s2
 
 
 # ---------------------------------------------------------------------------
@@ -167,10 +196,27 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
     in_e = used_e & lex_lt(bnd_e, e[:, None, :])
     v_in_e = jnp.where(diff, jnp.max(jnp.where(in_e, ver_e, 0), axis=1), 0)
 
-    # buckets strictly between
-    ar = jnp.arange(B, dtype=jnp.int32)[None, :]
-    between = (ar > ba[:, None]) & (ar < be[:, None])
-    v_btw = jnp.max(jnp.where(between, state.bmax[None, :], 0), axis=1)
+    # buckets strictly between ba and be: two-level max over bmax —
+    # whole superblocks strictly between the endpoints' superblocks via a
+    # dense [Q, B1] pass, partial edge superblocks via [Q, B2] block
+    # gathers (instead of one O(Q·B) dense pass)
+    B1, B2 = _split_factors(B)
+    bmax_blk = state.bmax.reshape(B1, B2)
+    bmax_sup = bmax_blk.max(axis=1)  # [B1]
+    s1a, s2a = ba // B2, ba % B2
+    s1e, s2e = be // B2, be % B2
+    ar1 = jnp.arange(B1, dtype=jnp.int32)[None, :]
+    full_sup = (ar1 > s1a[:, None]) & (ar1 < s1e[:, None])
+    v_sup = jnp.max(jnp.where(full_sup, bmax_sup[None, :], 0), axis=1)
+    ar2 = jnp.arange(B2, dtype=jnp.int32)[None, :]
+    blk_a = bmax_blk[jnp.maximum(s1a, 0)]  # [Q, B2]
+    hi2 = jnp.where(s1e == s1a, s2e, B2)
+    in_a = (ar2 > s2a[:, None]) & (ar2 < hi2[:, None])
+    v_edge_a = jnp.max(jnp.where(in_a, blk_a, 0), axis=1)
+    blk_e = bmax_blk[jnp.maximum(s1e, 0)]
+    in_e = (s1e > s1a)[:, None] & (ar2 < s2e[:, None])
+    v_edge_e = jnp.max(jnp.where(in_e, blk_e, 0), axis=1)
+    v_btw = jnp.maximum(v_sup, jnp.maximum(v_edge_a, v_edge_e))
 
     vmax = jnp.maximum(jnp.maximum(v_at_a, v_in_a), jnp.maximum(v_in_e, v_btw))
     hit = active & (vmax > snap)
@@ -233,6 +279,12 @@ def _log_shift_fill(val: jax.Array, have: jax.Array) -> jax.Array:
     return val
 
 
+def staging_slots(n_slots: int) -> int:
+    """Staging-plane width per touched bucket (distinct new boundaries a
+    single batch may land in one bucket before the host must repivot)."""
+    return max(4, n_slots // 2)
+
+
 def merge_writes(
     state: GridState,
     batch: Batch,
@@ -243,128 +295,146 @@ def merge_writes(
     """Raise V(k) to max(V(k), now) over committed write ranges; GC below
     ``oldest``; coalesce equal steps. Returns (new_state, pressure) where
     ``pressure`` = int32[2]: [max staged rows in any bucket (overflow if
-    > S), max kept rows in any bucket (overflow if > S)]."""
+    > staging_slots(S)), max kept rows in any bucket (overflow if > S)].
+
+    Cost is proportional to what the batch touches, not the grid: the full
+    sort/fill/compact merge runs only over the <= 2W buckets holding a
+    staged endpoint ([U, S + S2] where U = 2W); buckets merely *spanned* by
+    a committed write (covered, no endpoint inside) collapse to a single
+    gap at version ``now`` in one dense masked pass — the analog of the
+    reference separating probe from insert (SkipList.cpp:524 CheckMax vs
+    :511 addConflictRanges), keyed on the observation that a fully covered
+    bucket's whole step function becomes max(base, now) = now."""
     B, S, Lp1 = state.grid.shape
     L = Lp1 - 1
     T, KW, _ = batch.wb.shape
     Wtot = T * KW
+    N2 = 2 * Wtot
+    S2 = staging_slots(S)
+    U = min(N2, B)  # distinct touched buckets is bounded by both
 
     w_ok = lex_lt(batch.wb, batch.we) & commit[:, None]
     c = batch.wb.reshape(Wtot, L)
     d = batch.we.reshape(Wtot, L)
     ok = w_ok.reshape(Wtot)
+    okok = jnp.concatenate([ok, ok])
 
     bc = _rank_le(c, state.pivots)
     bd = _rank_le(d, state.pivots)
 
-    # staged rows: (code, ev) — begins carry +1, ends -1
+    # staged rows: (code, ev) — begins carry +1, ends -1; invalid rows get
+    # sentinel codes so they sort last
     codes = jnp.concatenate([c, d], axis=0)  # [2W, L]
+    codes = jnp.where(okok[:, None], codes, SENTINEL)
     evs = jnp.concatenate(
         [jnp.where(ok, 1, 0), jnp.where(ok, -1, 0)]
     ).astype(jnp.int32)
     bkt = jnp.where(
-        jnp.concatenate([ok, ok]),
-        jnp.concatenate([bc, bd]),
-        B,  # invalid → out of range, dropped by scatter
+        okok, jnp.concatenate([bc, bd]), B
     ).astype(jnp.int32)
 
-    # per-bucket event carry: events in earlier buckets (a write spanning
-    # buckets keeps later buckets covered until its end event)
-    ar = jnp.arange(B, dtype=jnp.int32)[None, :]
-    evsum = jnp.sum(
-        jnp.where(bkt[:, None] == ar, evs[:, None], 0), axis=0
-    )  # [B]
-    carry = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(evsum)[:-1]]
-    )
-
-    # sort staged rows by (bucket, code), then AGGREGATE equal (bucket,
-    # code) runs: one staged row per distinct boundary, carrying the run's
-    # event sum. Without this, a hot-key batch (many txns writing the same
-    # key) would stage more same-code rows than any repivoting could ever
-    # split across buckets.
-    N2 = 2 * Wtot
-    cols = (bkt,) + tuple(codes[:, i] for i in range(L)) + (evs,)
-    sorted_cols = jax.lax.sort(cols, num_keys=L + 1)
-    sb = sorted_cols[0]
-    scode = jnp.stack(sorted_cols[1 : L + 1], axis=1)
+    # sort staged rows by code (bucket is a monotone function of code, so
+    # this also groups buckets contiguously), then AGGREGATE equal-code
+    # runs: one staged row per distinct boundary, carrying the run's event
+    # sum. Without this, a hot-key batch (many txns writing the same key)
+    # would stage more same-code rows than any repivoting could split.
+    cols = tuple(codes[:, i] for i in range(L)) + (bkt, evs)
+    sorted_cols = jax.lax.sort(cols, num_keys=L)
+    scode = jnp.stack(sorted_cols[:L], axis=1)
+    sb = sorted_cols[L]
     sev = sorted_cols[L + 1]
-    idx = jnp.arange(N2, dtype=jnp.int32)
 
+    valid = sb < B
     code_new = jnp.concatenate(
-        [
-            jnp.ones(1, bool),
-            (sb[1:] != sb[:-1]) | (scode[1:] != scode[:-1]).any(axis=1),
-        ]
+        [jnp.ones(1, bool), (scode[1:] != scode[:-1]).any(axis=1)]
     )
     code_last = jnp.concatenate([code_new[1:], jnp.ones(1, bool)])
+    bkt_new = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+    bkt_last = jnp.concatenate([bkt_new[1:], jnp.ones(1, bool)])
+
     pe = jnp.cumsum(sev)
-    # event prefix just before each run, forward-filled across the run
     pe_prev = jnp.concatenate([jnp.zeros(1, jnp.int32), pe[:-1]])
-    pe_before = _log_shift_fill(
+    # event prefix just before each run, forward-filled across the run
+    pe_before_run = _log_shift_fill(
         jnp.where(code_new, pe_prev, 0)[None, :], code_new[None, :]
     )[0]
-    agg_ev = pe - pe_before  # valid at run-last rows
-
-    bkt_new = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
-    rl_cum = jnp.cumsum((code_last & (sb < B)).astype(jnp.int32))
-    rl_cum_prev = jnp.concatenate([jnp.zeros(1, jnp.int32), rl_cum[:-1]])
-    rl_base = _log_shift_fill(
-        jnp.where(bkt_new, rl_cum_prev, 0)[None, :], bkt_new[None, :]
+    agg_ev = pe - pe_before_run  # valid at run-last rows
+    pe_before_bkt = _log_shift_fill(
+        jnp.where(bkt_new, pe_prev, 0)[None, :], bkt_new[None, :]
     )[0]
-    slot = rl_cum - 1 - rl_base  # distinct-code slot within bucket
+    bkt_ev = pe - pe_before_bkt  # at bucket-last rows: the bucket's Σ ev
 
-    staged_cnt = jnp.zeros((B,), jnp.int32).at[sb].add(
-        jnp.where(code_last & (sb < B), 1, 0), mode="drop"
-    )
-    max_staged = jnp.max(staged_cnt)
+    # touched-bucket ordinal u (constant within a bucket's run of rows)
+    # and distinct-code slot within the bucket
+    ucum = jnp.cumsum((bkt_new & valid).astype(jnp.int32)) - 1
+    ccum = jnp.cumsum((code_new & valid).astype(jnp.int32))
+    ccum_at_bkt = _log_shift_fill(
+        jnp.where(bkt_new, ccum - 1, 0)[None, :], bkt_new[None, :]
+    )[0]
+    slot = ccum - 1 - ccum_at_bkt
 
-    # scatter run-last rows into [B, S] staging planes (flat 1-D index)
+    max_staged = jnp.max(jnp.where(code_last & valid, slot + 1, 0))
+
+    # staging planes [U, S2]: scatter run-last rows (flat 1-D index)
     flat = jnp.where(
-        code_last & (sb < B) & (slot < S), sb * S + slot, B * S
+        code_last & valid & (slot < S2), ucum * S2 + slot, U * S2
     )
-    st_code = jnp.full((B * S + 1, L), SENTINEL, dtype=jnp.uint32)
-    st_code = st_code.at[flat].set(scode, mode="drop")[: B * S].reshape(
-        B, S, L
+    st_code = jnp.full((U * S2 + 1, L), SENTINEL, dtype=jnp.uint32)
+    st_code = st_code.at[flat].set(scode, mode="drop")[: U * S2].reshape(
+        U, S2, L
     )
-    st_ev = jnp.zeros((B * S + 1,), jnp.int32).at[flat].set(
+    st_ev = jnp.zeros((U * S2 + 1,), jnp.int32).at[flat].set(
         agg_ev, mode="drop"
-    )[: B * S].reshape(B, S)
+    )[: U * S2].reshape(U, S2)
 
-    # merged per-bucket rows: old slots (tie 0) then staged (tie 1)
-    M = 2 * S
-    old_bnd = state.grid[..., :L]
-    old_used = jnp.arange(S)[None, :] < state.count[:, None]
-    old_bnd = jnp.where(old_used[..., None], old_bnd, SENTINEL)
-    old_ver = jnp.where(old_used, state.grid[..., L].astype(jnp.int32), 0)
+    # touched bucket ids [U] (B = unused slot)
+    tid = jnp.full((U + 1,), B, jnp.int32).at[
+        jnp.where(bkt_new & valid, ucum, U)
+    ].set(sb, mode="drop")[:U]
 
-    m_code = jnp.concatenate([old_bnd, st_code], axis=1)  # [B, M, L]
-    m_tie = jnp.concatenate(
-        [jnp.zeros((B, S), jnp.int32), jnp.ones((B, S), jnp.int32)], axis=1
+    # per-bucket event sums → carry[b] = Σ ev in buckets < b (a write
+    # spanning buckets keeps later buckets covered until its end event)
+    evsum_B = jnp.zeros((B + 1,), jnp.int32).at[
+        jnp.where(bkt_last & valid, sb, B)
+    ].add(jnp.where(bkt_last & valid, bkt_ev, 0), mode="drop")[:B]
+    carry = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(evsum_B)[:-1]]
     )
-    m_ver = jnp.concatenate([old_ver, jnp.zeros((B, S), jnp.int32)], axis=1)
-    m_ev = jnp.concatenate([jnp.zeros((B, S), jnp.int32), st_ev], axis=1)
+
+    # gather the touched buckets' subgrids and merge [U, S + S2]
+    tid_c = jnp.minimum(tid, B - 1)
+    u_live = tid < B
+    old = state.grid[tid_c]  # [U, S, L+1] block gather
+    old_used = (
+        jnp.arange(S)[None, :] < state.count[tid_c][:, None]
+    ) & u_live[:, None]
+    old_code = jnp.where(old_used[..., None], old[..., :L], SENTINEL)
+    old_ver = jnp.where(old_used, old[..., L].astype(jnp.int32), 0)
+
+    M = S + S2
+    m_code = jnp.concatenate([old_code, st_code], axis=1)  # [U, M, L]
+    m_ver = jnp.concatenate([old_ver, jnp.zeros((U, S2), jnp.int32)], axis=1)
+    m_ev = jnp.concatenate([jnp.zeros((U, S), jnp.int32), st_ev], axis=1)
     m_old = jnp.concatenate(
-        [old_used.astype(jnp.int32), jnp.zeros((B, S), jnp.int32)], axis=1
+        [old_used.astype(jnp.int32), jnp.zeros((U, S2), jnp.int32)], axis=1
     )
 
-    cols = tuple(m_code[..., i] for i in range(L)) + (
-        m_tie,
-        m_ver,
-        m_ev,
-        m_old,
-    )
-    sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=L + 1)
-    g_code = jnp.stack(sorted_cols[:L], axis=-1)  # [B, M, L]
-    g_ver = sorted_cols[L + 1]
-    g_ev = sorted_cols[L + 2]
-    g_old = sorted_cols[L + 3].astype(bool)
+    # sort by code only: within an equal-code run the fills/prefix sums
+    # below are order-independent (the run-last row sees the full prefix,
+    # and at most one old row exists per code)
+    cols = tuple(m_code[..., i] for i in range(L)) + (m_ver, m_ev, m_old)
+    sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=L)
+    g_code = jnp.stack(sorted_cols[:L], axis=-1)  # [U, M, L]
+    g_ver = sorted_cols[L]
+    g_ev = sorted_cols[L + 1]
+    g_old = sorted_cols[L + 2].astype(bool)
 
     # forward-fill gap base values from old rows
     base = _log_shift_fill(jnp.where(g_old, g_ver, 0), g_old)
 
     # coverage prefix: gap starting at row m is covered iff carry + Σ ev > 0
-    cov = carry[:, None] + jnp.cumsum(g_ev, axis=1)
+    carry_in = jnp.where(u_live, carry[tid_c], 0)
+    cov = carry_in[:, None] + jnp.cumsum(g_ev, axis=1)
     covered = cov > 0
 
     nv = jnp.where(covered, jnp.maximum(base, now), base)
@@ -375,7 +445,7 @@ def merge_writes(
     nxt_differs = jnp.concatenate(
         [
             (g_code[:, 1:] != g_code[:, :-1]).any(axis=-1),
-            jnp.ones((B, 1), bool),
+            jnp.ones((U, 1), bool),
         ],
         axis=1,
     )
@@ -390,7 +460,7 @@ def merge_writes(
     shifted_nv = jnp.pad(nv, ((0, 0), (1, 0)), constant_values=-1)[:, :M]
     first_of_run = jnp.concatenate(
         [
-            jnp.ones((B, 1), bool),
+            jnp.ones((U, 1), bool),
             (g_code[:, 1:] != g_code[:, :-1]).any(axis=-1),
         ],
         axis=1,
@@ -401,7 +471,7 @@ def merge_writes(
     keep = keep & (nv != pval)
 
     kept_cnt = keep.sum(axis=1, dtype=jnp.int32)
-    max_kept = jnp.max(kept_cnt)
+    max_kept = jnp.max(jnp.where(u_live, kept_cnt, 0))
 
     # compact: stable sort by !keep, take first S rows
     cols = (jnp.where(keep, 0, 1).astype(jnp.int32),) + tuple(
@@ -411,14 +481,34 @@ def merge_writes(
     out_code = jnp.stack(sorted_cols[1 : L + 1], axis=-1)[:, :S, :]
     out_ver = sorted_cols[L + 1][:, :S]
 
-    new_count = jnp.minimum(kept_cnt, S)
-    used = jnp.arange(S)[None, :] < new_count[:, None]
+    new_count_u = jnp.minimum(kept_cnt, S)
+    used = jnp.arange(S)[None, :] < new_count_u[:, None]
     out_code = jnp.where(used[..., None], out_code, SENTINEL)
     out_ver = jnp.where(used, out_ver, 0)
-    new_grid = jnp.concatenate(
+    out_rows = jnp.concatenate(
         [out_code, out_ver.astype(jnp.uint32)[..., None]], axis=-1
     )
-    new_bmax = jnp.max(out_ver, axis=1)
+    out_bmax = jnp.max(out_ver, axis=1)
+
+    # scatter merged subgrids back (unused u slots have tid == B → dropped)
+    new_grid = state.grid.at[tid].set(out_rows, mode="drop")
+    new_count = state.count.at[tid].set(new_count_u, mode="drop")
+    new_bmax = state.bmax.at[tid].set(out_bmax, mode="drop")
+
+    # untouched-but-covered buckets (a committed write spans them without
+    # an endpoint inside): the whole bucket's step function becomes
+    # max(base, now) = now, i.e. a single gap from the pivot — one dense
+    # masked pass over the grid
+    is_touched = jnp.zeros((B + 1,), bool).at[tid].set(True, mode="drop")[:B]
+    covered_b = (carry > 0) & ~is_touched
+    collapsed = jnp.full((B, S, Lp1), SENTINEL, dtype=jnp.uint32)
+    collapsed = collapsed.at[:, :, L].set(0)
+    collapsed = collapsed.at[:, 0, :L].set(state.pivots)
+    collapsed = collapsed.at[:, 0, L].set(now.astype(jnp.uint32))
+    cmask = covered_b[:, None, None]
+    new_grid = jnp.where(cmask, collapsed, new_grid)
+    new_count = jnp.where(covered_b, 1, new_count)
+    new_bmax = jnp.where(covered_b, now, new_bmax)
 
     pressure = jnp.stack([max_staged, max_kept])
     return (
@@ -492,6 +582,95 @@ def rebase(state: GridState, delta: jax.Array) -> GridState:
     return GridState(state.pivots, grid, state.count, jnp.max(ver, axis=1))
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def reshard_device(
+    state: GridState, n_buckets: int, n_slots: int
+) -> tuple[GridState, jax.Array]:
+    """Rebalance the grid ON DEVICE: new pivots = row-count quantiles of
+    the live boundary set, every live row permuted into its new bucket.
+    No host round trip — the grid (tens of MB) never crosses the tunnel,
+    which is what made host resharding cost ~2s.
+
+    Because pivots are chosen FROM the live boundaries, each new bucket's
+    first assigned row is exactly its pivot row, so the slot-0-is-the-pivot
+    invariant holds with no insertion step, and inheritance is implicit.
+
+    Returns (new_state, pressure): pressure = max rows any new bucket
+    needs; if > n_slots the caller must retry with more buckets (rows were
+    dropped — the state is unusable)."""
+    B, S, Lp1 = state.grid.shape
+    L = Lp1 - 1
+    N = B * S
+    used = (jnp.arange(S)[None, :] < state.count[:, None]).reshape(N)
+    code = jnp.where(
+        used[:, None], state.grid[..., :L].reshape(N, L), SENTINEL
+    )
+    ver = jnp.where(used, state.grid[..., L].reshape(N), 0)
+
+    # compact live rows to the front, preserving global key order (rows
+    # are sorted within buckets and buckets are ordered): prefix-sum
+    # destination + scatter — stable by construction and far cheaper to
+    # compile and run than a 1M-row multi-operand sort
+    n_live = used.sum(dtype=jnp.int32)
+    dest = jnp.cumsum(used.astype(jnp.int32)) - 1
+    dest = jnp.where(used, dest, N)
+    lcode = jnp.full((N + 1, L), SENTINEL, dtype=jnp.uint32).at[dest].set(
+        code, mode="drop"
+    )[:N]
+    lver = jnp.zeros((N + 1,), ver.dtype).at[dest].set(ver, mode="drop")[:N]
+    lused = jnp.arange(N, dtype=jnp.int32) < n_live
+
+    # pivots: strictly increasing quantile indices into the live rows
+    # (live codes are distinct, so distinct indices → distinct pivots —
+    # a DUPLICATE pivot would create a zero-width bucket whose stale bmax
+    # could later fake conflicts)
+    Bp = n_buckets - 1
+    n_piv = jnp.minimum(Bp, n_live - 1)
+    i = jnp.arange(1, Bp + 1, dtype=jnp.int32)
+    idx = 1 + ((i - 1) * (n_live - 1)) // jnp.maximum(n_piv, 1)
+    pvalid = i <= n_piv
+    idx = jnp.where(pvalid, jnp.minimum(idx, N - 1), N - 1)
+    pcode = jnp.where(pvalid[:, None], lcode[idx], SENTINEL)
+    new_pivots = jnp.concatenate(
+        [jnp.zeros((1, L), jnp.uint32), pcode], axis=0
+    )
+
+    # permute rows into new buckets. No ranking needed: pivots are drawn
+    # FROM the sorted live rows, so row j's bucket = #(pivot indices <= j)
+    # - 1 — a 16K-element scatter + cumsum instead of an O(N·B) compare
+    # (or an O(N·B2) gather that blows HBM at N ~ 1M).
+    marks = jnp.zeros((N,), jnp.int32).at[0].set(1)
+    marks = marks.at[jnp.where(pvalid, idx, N)].add(1, mode="drop")
+    nb = jnp.cumsum(marks) - 1
+    nb = jnp.where(lused, nb, n_buckets).astype(jnp.int32)
+    pos = jnp.arange(N, dtype=jnp.int32)
+    nb_new = jnp.concatenate([jnp.ones(1, bool), nb[1:] != nb[:-1]])
+    bucket_start = _log_shift_fill(
+        jnp.where(nb_new, pos, 0)[None, :], nb_new[None, :]
+    )[0]
+    slot = pos - bucket_start
+    pressure = jnp.max(jnp.where(lused, slot + 1, 0))
+
+    flat = jnp.where(
+        lused & (slot < n_slots), nb * n_slots + slot, n_buckets * n_slots
+    )
+    rows = jnp.concatenate([lcode, lver[:, None]], axis=1)
+    g = jnp.full((n_buckets * n_slots + 1, Lp1), SENTINEL, dtype=jnp.uint32)
+    g = g.at[flat].set(rows, mode="drop")[: n_buckets * n_slots]
+    new_grid = g.reshape(n_buckets, n_slots, Lp1)
+    is_row = (new_grid[..., :L] != SENTINEL).any(axis=-1)
+    new_count = is_row.sum(axis=1, dtype=jnp.int32)
+    out_ver = jnp.where(is_row, new_grid[..., L].astype(jnp.int32), 0)
+    new_grid = jnp.concatenate(
+        [new_grid[..., :L], out_ver.astype(jnp.uint32)[..., None]], axis=-1
+    )
+    new_bmax = jnp.max(out_ver, axis=1)
+    return (
+        GridState(new_pivots, new_grid, new_count, new_bmax),
+        pressure,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side construction / resharding (rare, numpy)
 
@@ -513,71 +692,139 @@ def make_state(n_buckets: int, n_slots: int, lanes: int) -> GridState:
     )
 
 
-def reshard_host(
-    state: GridState, new_pivot_codes: np.ndarray, n_buckets: int, n_slots: int
-) -> GridState:
-    """Rebuild the grid under new pivots (numpy; rare — init, growth, or
-    skew). Preserves the step function exactly: every live boundary is
-    re-bucketed and each new pivot becomes a boundary inheriting the value
-    of the gap containing it."""
-    pivots_old = np.asarray(state.pivots)
+def codes_to_bytes(codes: np.ndarray) -> np.ndarray:
+    """uint32[N, L] lane codes → void-dtype byte keys whose memcmp order
+    equals lane order (big-endian), for vectorized searchsorted."""
+    n, L = codes.shape
+    be = np.ascontiguousarray(codes.astype(">u4"))
+    return be.view(np.dtype((np.void, 4 * L))).reshape(n)
+
+
+def live_rows(state: GridState) -> tuple[np.ndarray, np.ndarray]:
+    """(codes uint32[N, L], versions int64[N]) of all live boundaries, in
+    global key order (buckets are ordered and sorted internally)."""
     grid = np.asarray(state.grid)
     count = np.asarray(state.count)
     B_old, S_old, Lp1 = grid.shape
+    used = np.arange(S_old)[None, :] < count[:, None]
+    codes = grid[..., : Lp1 - 1][used]
+    vers = grid[..., Lp1 - 1][used].astype(np.int64)
+    return codes, vers
+
+
+def reshard_host(
+    state: GridState, new_pivot_codes: np.ndarray, n_buckets: int, n_slots: int
+) -> GridState:
+    """Rebuild the grid under new pivots (vectorized numpy; rare — init,
+    growth, or skew). Preserves the step function exactly: every live
+    boundary is re-bucketed and each new pivot becomes a boundary
+    inheriting the value of the gap containing it."""
+    grid = np.asarray(state.grid)
+    Lp1 = grid.shape[-1]
     L = Lp1 - 1
 
-    rows = []
-    for b in range(B_old):
-        for s in range(int(count[b])):
-            rows.append((tuple(int(x) for x in grid[b, s, :L]), int(grid[b, s, L])))
-    rows.sort()
+    codes, vers = live_rows(state)
+    keys = codes_to_bytes(codes)
 
-    piv = [tuple(int(x) for x in p) for p in new_pivot_codes]
-    assert piv[0] == tuple([0] * L), "pivot 0 must be the empty key"
-    assert len(piv) <= n_buckets
+    piv = np.asarray(new_pivot_codes, dtype=np.uint32).reshape(-1, L)
+    assert not piv[0].any(), "pivot 0 must be the empty key"
+    P = piv.shape[0]
+    assert P <= n_buckets
+    piv_keys = codes_to_bytes(piv)
 
-    import bisect as _b
+    # pivot rows inherit the value of the gap containing them (live row 0
+    # is always the old bucket-0 pivot at code 0, so idx >= 0)
+    idx = np.searchsorted(keys, piv_keys, side="right") - 1
+    inherit = vers[idx]
 
-    keys = [r[0] for r in rows]
+    # combined row set: pivots first so an equal-coded live row (sorted
+    # after) wins the dedupe-keep-last rule
+    all_codes = np.concatenate([piv, codes])
+    all_vers = np.concatenate([inherit, vers])
+    all_bkt = np.concatenate(
+        [
+            np.arange(P, dtype=np.int64),
+            np.searchsorted(piv_keys, keys, side="right") - 1,
+        ]
+    )
+    all_keys = codes_to_bytes(all_codes)
+    is_piv = np.concatenate(
+        [np.ones(P, dtype=np.int8), np.zeros(len(vers), dtype=np.int8)]
+    )
+    order = np.lexsort((1 - is_piv, all_keys))  # by key, pivots first
+    k_s = all_keys[order]
+    v_s = all_vers[order]
+    b_s = all_bkt[order]
+    c_s = all_codes[order]
+    p_s = is_piv[order].astype(bool)
+
+    # dedupe equal keys keeping the LAST (live-row value wins over pivot
+    # inheritance); a deduped-away pivot row keeps its pivot-ness
+    n = len(k_s)
+    last = np.ones(n, dtype=bool)
+    last[:-1] = k_s[:-1] != k_s[1:]
+    first = np.ones(n, dtype=bool)
+    first[1:] = k_s[1:] != k_s[:-1]
+    # propagate pivot flag to the kept (last) row of each run: runs have
+    # length 1 or 2 (pivot + live row), so OR with the previous row
+    piv_kept = p_s.copy()
+    piv_kept[1:] |= p_s[:-1] & ~first[1:]
+
+    k_d = k_s[last]
+    v_d = v_s[last]
+    b_d = b_s[last]
+    c_d = c_s[last]
+    p_d = piv_kept[last]
+
+    # coalesce: drop rows whose value equals the previous kept row's value
+    # — except pivot rows, which always stay (slot 0 invariant). Equality
+    # is transitive, so compare against the previous ROW after noting that
+    # dropped rows always share the kept predecessor's value.
+    m = len(k_d)
+    prev_val = np.empty(m, dtype=np.int64)
+    prev_val[0] = -1
+    prev_val[1:] = v_d[:-1]
+    keep = p_d | (v_d != prev_val)
+    # a non-pivot row after a DROPPED row: compare against the last kept
+    # value — iterate via np: since dropped rows have value == their
+    # predecessor's, chains of equal values collapse; keep = value changed
+    # from previous row, or pivot. (A row equal to a dropped predecessor
+    # is equal to the kept ancestor too — transitive — so this is exact.)
+
+    k_k = k_d[keep]
+    v_k = v_d[keep]
+    b_k = b_d[keep]
+    c_k = c_d[keep]
+
+    # slot index within bucket
+    nkeep = len(k_k)
+    bucket_first = np.ones(nkeep, dtype=bool)
+    bucket_first[1:] = b_k[1:] != b_k[:-1]
+    pos = np.arange(nkeep, dtype=np.int64)
+    run_start = np.maximum.accumulate(np.where(bucket_first, pos, 0))
+    slot = pos - run_start
+
+    counts = np.zeros(n_buckets, dtype=np.int64)
+    np.add.at(counts, b_k, 1)
+    if counts.max(initial=0) > n_slots:
+        worst = int(counts.argmax())
+        raise OverflowError(
+            f"bucket {worst} needs {int(counts[worst])} slots > {n_slots}"
+        )
+
     new_grid = np.full((n_buckets, n_slots, Lp1), 0xFFFFFFFF, dtype=np.uint32)
-    new_count = np.zeros((n_buckets,), np.int32)
-    new_bmax = np.zeros((n_buckets,), np.int32)
-    bounds_per = [[] for _ in range(len(piv))]
-    for k, v in rows:
-        nb = _b.bisect_right(piv, k) - 1
-        bounds_per[nb].append((k, v))
-    for nb, plist in enumerate(bounds_per):
-        # pivot row first, inheriting the gap value at the pivot
-        if not plist or plist[0][0] != piv[nb]:
-            i = _b.bisect_right(keys, piv[nb]) - 1
-            inherit = rows[i][1] if i >= 0 else 0
-            plist.insert(0, (piv[nb], inherit))
-        # coalesce: drop a boundary whose step value equals the previous
-        # kept one (the pivot row at index 0 is always kept); duplicate
-        # keys keep the later value
-        out = []
-        for k, v in plist:
-            if out and out[-1][0] == k:
-                out[-1] = (k, v)
-                continue
-            if out and out[-1][1] == v:
-                continue
-            out.append((k, v))
-        if len(out) > n_slots:
-            raise OverflowError(
-                f"bucket {nb} needs {len(out)} slots > {n_slots}"
-            )
-        for s, (k, v) in enumerate(out):
-            new_grid[nb, s, :L] = k
-            new_grid[nb, s, L] = v
-        new_count[nb] = len(out)
-        new_bmax[nb] = max((v for _k, v in out), default=0)
+    new_grid[..., L] = 0
+    new_grid[b_k, slot, :L] = c_k
+    new_grid[b_k, slot, L] = v_k.astype(np.uint32)
+    new_count = counts.astype(np.int32)
+    new_bmax = np.zeros(n_buckets, dtype=np.int64)
+    np.maximum.at(new_bmax, b_k, v_k)
+
     new_pivots = np.full((n_buckets, L), 0xFFFFFFFF, dtype=np.uint32)
-    for nb, p in enumerate(piv):
-        new_pivots[nb] = p
+    new_pivots[:P] = piv
     return GridState(
         pivots=jnp.asarray(new_pivots),
         grid=jnp.asarray(new_grid),
         count=jnp.asarray(new_count),
-        bmax=jnp.asarray(new_bmax),
+        bmax=jnp.asarray(new_bmax.astype(np.int32)),
     )

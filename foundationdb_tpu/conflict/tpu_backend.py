@@ -28,7 +28,7 @@ from . import keys as K
 from .api import CommitTransaction, ConflictSet, Verdict
 
 _INT32_REBASE_THRESHOLD = 1 << 30
-_SAMPLE_CAP = 32768
+_SAMPLE_CAP = 131072
 
 
 def _bucket(n: int, floor: int = 1) -> int:
@@ -38,20 +38,20 @@ def _bucket(n: int, floor: int = 1) -> int:
     return b
 
 
-def _pick_pivots(cands, zero, n_buckets: int, lanes: int) -> np.ndarray:
-    """≤ n_buckets-1 quantile pivots from sorted candidate codes; bucket 0
-    always starts at the empty key."""
+def _pick_pivots(cands: np.ndarray, n_buckets: int, lanes: int) -> np.ndarray:
+    """≤ n_buckets-1 quantile pivots from sorted unique candidate codes
+    (uint32[N, lanes], none equal to the zero code); bucket 0 always
+    starts at the empty key."""
+    zero = np.zeros((1, lanes), dtype=np.uint32)
     n_piv = min(n_buckets - 1, len(cands))
-    pivots = [zero]
-    seen = {zero}
-    if n_piv > 0:
-        step = len(cands) / (n_piv + 1)
-        for i in range(1, n_piv + 1):
-            c = cands[min(int(i * step), len(cands) - 1)]
-            if c not in seen:
-                seen.add(c)
-                pivots.append(c)
-    return np.asarray(pivots, dtype=np.uint32).reshape(-1, lanes)
+    if n_piv <= 0:
+        return zero
+    step = len(cands) / (n_piv + 1)
+    idx = np.minimum(
+        (np.arange(1, n_piv + 1) * step).astype(np.int64), len(cands) - 1
+    )
+    idx = np.unique(idx)
+    return np.concatenate([zero, cands[idx]], axis=0)
 
 
 class TpuConflictSet(ConflictSet):
@@ -70,6 +70,7 @@ class TpuConflictSet(ConflictSet):
         self._sample: list[bytes] = []
         self._sample_skip = 0
         self._resharded_once = False
+        self._rebalance_wanted = False
         # dispatched-but-uncollected groups, in dispatch order
         self._inflight: list[dict] = []
 
@@ -140,6 +141,14 @@ class TpuConflictSet(ConflictSet):
 
         if not self._resharded_once:
             self._reshard(self._state)
+        elif self._rebalance_wanted:
+            # a prior collect saw pressure near the slot ceiling: drain
+            # the pipeline and rebalance BEFORE dispatching more work — a
+            # deliberate one-group bubble instead of an overflow replay of
+            # every in-flight group later
+            self._flush()
+            self._reshard(self._state)
+            self._rebalance_wanted = False
 
         stacked = self._stack(batches)
         group = {
@@ -182,24 +191,40 @@ class TpuConflictSet(ConflictSet):
         while self._inflight and self._inflight[0] is not group:
             self._collect(self._inflight[0])
         assert self._inflight and self._inflight[0] is group
+        S2 = G.staging_slots(self._S)
         for attempt in range(6):
-            pr = np.asarray(group["pressure"])
-            if int(pr.max(initial=0)) <= self._S:
+            # one host↔device round trip for both pressure and verdicts
+            pr, out = jax.device_get((group["pressure"], group["verdicts"]))
+            if int(pr[0]) <= S2 and int(pr[1]) <= self._S:
                 break
-            # overflow: some bucket needed more slots than S — rebuild the
-            # grid under fresh pivots from the pre-group snapshot, then
-            # replay this group and everything dispatched after it
-            self._reshard(group["snapshot"], grow=attempt >= 1)
+            # overflow: some bucket needed more staging/grid slots than it
+            # has — rebuild the grid under fresh pivots from the pre-group
+            # snapshot, then replay this group and everything after it.
+            # Attempt 0: cheap on-device rebalance (handles live-set skew).
+            # Attempt 1+: host reshard whose pivots include the recent key
+            # SAMPLE — a device rebalance can only split between live
+            # boundaries, which never converges when the overflowing batch
+            # floods a single gap with brand-new keys (append workloads).
+            self._reshard(
+                group["snapshot"], grow=attempt >= 2, with_sample=attempt >= 1
+            )
             for g in self._inflight:
                 self._dispatch(g)
         else:
             raise RuntimeError("conflict grid reshard did not converge")
-        if int(pr.max(initial=0)) > self._S - max(4, self._S // 4):
-            # close to the slot ceiling: rebalance before the next group
-            # (only safe with nothing else in flight on the old layout)
+        if int(pr[1]) > self._S - max(4, self._S // 4) or int(pr[0]) > S2 - max(
+            2, S2 // 4
+        ):
+            # close to the slot ceiling: rebalance before more work lands.
+            # With nothing else in flight do it now; otherwise flag it for
+            # the next dispatch (which drains the pipeline first). Growth
+            # is reshard_device's own call — it grows exactly when a
+            # balanced quantile split can't fit its slot budget.
             if len(self._inflight) == 1:
-                self._reshard(self._state, grow=int(pr[1]) > self._S - 4)
-        out = np.asarray(group["verdicts"])
+                self._reshard(self._state)
+                self._rebalance_wanted = False
+            else:
+                self._rebalance_wanted = True
         group["done"] = [
             [Verdict(int(v)) for v in out[g, : group["counts"][g]]]
             for g in range(len(group["counts"]))
@@ -304,32 +329,56 @@ class TpuConflictSet(ConflictSet):
         elif self._sample_skip % 17 == 0:
             self._sample[self._sample_skip % _SAMPLE_CAP] = key
 
-    def _reshard(self, from_state: G.GridState, grow: bool = False) -> None:
-        """Rebuild the grid under fresh pivots: quantiles of the live
-        boundary set (plus the key sample early on). Rare: init, skew,
-        growth."""
+    def _reshard(
+        self,
+        from_state: G.GridState,
+        grow: bool = False,
+        with_sample: bool = False,
+    ) -> None:
+        """Rebuild the grid under fresh pivots. Normally this runs
+        entirely ON DEVICE (grid.reshard_device — no grid download/upload
+        over the tunnel), balancing on the LIVE boundary set. That can't
+        split a gap that a new batch floods with keys the grid has never
+        seen (an append workload writing past the last boundary), so
+        overflow-replay escalation and the initial reshard use the host
+        path, whose pivots also come from the recent key sample."""
+        if self._resharded_once and not with_sample:
+            if grow:
+                self._B *= 2
+            while True:
+                state, pressure = G.reshard_device(from_state, self._B, self._S)
+                if int(jax.device_get(pressure)) <= self._S:
+                    self._state = state
+                    return
+                # quantile split can't fit: more buckets and retry
+                self._B *= 2
+        self._reshard_host_sampled(from_state, grow=grow)
+
+    def _reshard_host_sampled(
+        self, from_state: G.GridState, grow: bool = False
+    ) -> None:
+        """Host reshard: pivots from live boundaries ∪ the key sample
+        (covers keys arriving in not-yet-merged batches)."""
+        if grow:
+            self._B *= 2
         state = from_state
-        grid = np.asarray(state.grid)
-        count = np.asarray(state.count)
         L = self._lanes
-
-        live: list[tuple] = []
-        for b in range(grid.shape[0]):
-            for s in range(int(count[b])):
-                live.append(tuple(int(x) for x in grid[b, s, :L]))
+        codes, _vers = G.live_rows(state)
         if self._sample:
-            codes = K.encode_keys(self._sample, self._width)
-            live.extend(tuple(int(x) for x in c) for c in codes)
-        live = sorted(set(live))
+            codes = np.concatenate(
+                [codes, K.encode_keys(self._sample, self._width)]
+            )
+        keys = G.codes_to_bytes(np.ascontiguousarray(codes))
+        _, uniq_idx = np.unique(keys, return_index=True)
+        cands = codes[uniq_idx]  # sorted unique (void sort = lane order)
+        cands = cands[cands.any(axis=1)]  # pivot 0 (zero code) is implicit
 
-        n_live = sum(int(c) for c in count)
-        if grow or n_live * 2 > self._B * self._S:
+        n_live = int(np.asarray(state.count).sum())
+        if n_live * 2 > self._B * self._S:
             self._B *= 2
 
-        zero = tuple([0] * L)
-        cands = [c for c in live if c != zero]
         while True:
-            pivot_codes = _pick_pivots(cands, zero, self._B, L)
+            pivot_codes = _pick_pivots(cands, self._B, L)
             try:
                 self._state = G.reshard_host(state, pivot_codes, self._B, self._S)
                 break

@@ -30,6 +30,16 @@ class KeyRangeMap:
         end = self._bounds[i + 1] if i + 1 < len(self._bounds) else None
         return self._bounds[i], end, self._vals[i]
 
+    def range_before(self, key: bytes) -> Tuple[bytes, Optional[bytes], Any]:
+        """(begin, end, value) of the range containing the keys immediately
+        BELOW ``key`` (i.e. the predecessor's range). For key == b"" there is
+        no predecessor; returns the first range."""
+        i = self._idx(key)
+        if self._bounds[i] == key and i > 0:
+            i -= 1
+        end = self._bounds[i + 1] if i + 1 < len(self._bounds) else None
+        return self._bounds[i], end, self._vals[i]
+
     def insert(self, begin: bytes, end: Optional[bytes], value: Any) -> None:
         """Set value on [begin, end); end=None means to infinity."""
         if end is not None and begin >= end:

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
+from ..runtime.locality import Locality
 from ..runtime.knobs import Knobs
 from ..runtime.loop import EventLoop, TaskPriority, set_loop
 from ..runtime.trace import SevInfo, SevWarn, trace
@@ -44,10 +45,11 @@ class Endpoint:
 
 
 class SimProcess:
-    def __init__(self, sim: "Sim", address: str, machine: str, boot=None):
+    def __init__(self, sim: "Sim", address: str, machine: str, boot=None, locality=None):
         self.sim = sim
         self.address = address
         self.machine = machine
+        self.locality = locality or Locality.of(machine)
         self.boot = boot  # async fn(process) rerun on reboot
         self.endpoints: dict[str, Callable] = {}  # token → async handler
         self.actors = ActorCollection()
@@ -90,12 +92,27 @@ class Sim:
 
     # -- world construction ---------------------------------------------------
 
-    def new_process(self, address: str, machine: str = None, boot=None) -> SimProcess:
-        p = SimProcess(self, address, machine or address, boot)
+    def new_process(
+        self, address: str, machine: str = None, boot=None, zone: str = None,
+        dc: str = "dc0",
+    ) -> SimProcess:
+        machine = machine or address
+        loc = Locality.of(machine, zone=zone, dc=dc)
+        p = SimProcess(self, address, machine, boot, locality=loc)
         self.processes[address] = p
         if boot is not None:
             p.spawn(boot(p))
         return p
+
+    def kill_zone(self, zone: str) -> list[str]:
+        """Kill every process in a failure domain (the simulator's
+        machine/zone kill, fdbrpc/simulator.h:148 KillType)."""
+        killed = []
+        for addr, p in list(self.processes.items()):
+            if p.alive and p.locality.zone == zone:
+                self.kill_process(addr)
+                killed.append(addr)
+        return killed
 
     # -- messaging ------------------------------------------------------------
 

@@ -370,6 +370,51 @@ class AsyncTrigger:
         return self._f
 
 
+class RequestBatcher:
+    """Natural request coalescing: one fetch in flight at a time; callers
+    that arrive during a flight form the next batch and share its result.
+    The pattern behind GRV batching at both ends (the client's
+    readVersionBatcher, fdbclient/NativeAPI.actor.cpp:1290, and the
+    proxy's transactionStarter master fetch,
+    fdbserver/MasterProxyServer.actor.cpp:925). Joining an *in-flight*
+    fetch would break causality (a result observed elsewhere after the
+    fetch began could be newer), so only pre-flight arrivals share.
+
+    ``fetch`` is a zero-arg coroutine function; ``spawn`` schedules the
+    batcher actor (e.g. ``process.spawn`` or a client's spawn)."""
+
+    def __init__(self, fetch, spawn_fn):
+        self._fetch = fetch
+        self._spawn = spawn_fn
+        self._waiters: list[Future] = []
+        self._running = False
+
+    def join(self) -> Future:
+        fut: Future = Future()
+        self._waiters.append(fut)
+        if not self._running:
+            self._running = True
+            self._spawn(self._run())
+        return fut
+
+    async def _run(self):
+        try:
+            while self._waiters:
+                waiters, self._waiters = self._waiters, []
+                try:
+                    value = await self._fetch()
+                except BaseException as e:
+                    for w in waiters:
+                        if not w.is_ready():
+                            w._set_error(e)
+                    continue
+                for w in waiters:
+                    if not w.is_ready():
+                        w._set(value)
+        finally:
+            self._running = False
+
+
 class VersionGate:
     """Orders batch application by (prev_version → version) chaining — the
     sequencing discipline shared by resolvers (Resolver.actor.cpp:104-122)

@@ -156,13 +156,18 @@ class DynamicCluster:
         n_workers: int = None,
         knobs: Knobs = None,
         prefix: str = "",  # distinct prefixes let several clusters share a sim
+        n_zones: int = 0,  # >0: spread workers over failure domains
     ):
         self.sim = sim
         self.config = cfg = config or ClusterConfig()
         self.knobs = knobs or sim.knobs
+
+        def zone_of(i: int):
+            return f"{prefix}z{i % n_zones}" if n_zones else None
+
         self.coordinators = [f"{prefix}coord{i}" for i in range(n_coordinators)]
-        for addr in self.coordinators:
-            sim.new_process(addr, boot=_boot_coordinator)
+        for i, addr in enumerate(self.coordinators):
+            sim.new_process(addr, boot=_boot_coordinator, zone=zone_of(i))
 
         # worker fleet: storage-class + transaction-class + stateless
         if n_workers is None:
@@ -182,7 +187,12 @@ class DynamicCluster:
             + ["stateless"] * n_stateless
         )
         self.worker_addrs = []
+        # zone assignment strides WITHIN each class so every class spans
+        # all zones (e.g. 6 storage workers over 3 zones = 2 per zone)
+        per_class_idx: dict = {}
         for i, pclass in enumerate(classes):
+            j = per_class_idx.get(pclass, 0)
+            per_class_idx[pclass] = j + 1
             addr = f"{prefix}worker{i}"
             self.worker_addrs.append(addr)
             sim.new_process(
@@ -190,6 +200,7 @@ class DynamicCluster:
                 boot=_make_worker_boot(
                     self.coordinators, pclass, cfg.as_dict(), self.knobs
                 ),
+                zone=zone_of(j),
             )
 
 

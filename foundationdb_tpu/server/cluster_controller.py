@@ -78,7 +78,12 @@ class ClusterController:
     async def register_worker(self, req: RegisterWorkerRequest):
         self.workers[req.address] = (
             WorkerDetails(
-                address=req.address, process_class=req.process_class, roles=req.roles
+                address=req.address,
+                process_class=req.process_class,
+                roles=req.roles,
+                machine=getattr(req, "machine", ""),
+                zone=getattr(req, "zone", ""),
+                dc=getattr(req, "dc", "dc0"),
             ),
             now(),
         )

@@ -31,12 +31,22 @@ from .movekeys import move_shard, take_move_keys_lock
 
 
 class DataDistributor:
-    def __init__(self, process, db, storage, knobs, replication: int, uid: str = ""):
+    def __init__(
+        self,
+        process,
+        db,
+        storage,
+        knobs,
+        replication: int,
+        uid: str = "",
+        zones: dict = None,  # tag → zone (policy-driven placement)
+    ):
         self.process = process
         self.db = db  # Database over this epoch's proxies
         self.storage = list(storage)  # [StorageInterface]
         self.knobs = knobs
         self.replication = replication
+        self.zones = dict(zones or {})
         # moveKeysLock owner id: this DD's claim on shard relocation;
         # a successor DD overwrites it and our movers abort (movekeys.py)
         self.uid = uid or f"dd-{process.address}"
@@ -172,6 +182,25 @@ class DataDistributor:
                 key=lambda t: load[t],
             )
             need = max(self.replication - len(healthy), 0)
+            # policy-driven choice (ReplicationPolicy.h PolicyAcross over
+            # zoneId): keep the rebuilt team's zones distinct when the
+            # remaining topology allows; availability beats placement
+            # otherwise
+            if self.zones and need:
+                used_zones = {self.zones.get(t) for t in healthy}
+                distinct: list = []
+                for t in candidates:
+                    z = self.zones.get(t)
+                    if z in used_zones:
+                        continue
+                    distinct.append(t)
+                    used_zones.add(z)
+                    if len(distinct) == need:
+                        break
+                if len(distinct) == need:
+                    candidates = distinct + [
+                        t for t in candidates if t not in distinct
+                    ]
             if need > len(candidates):
                 trace(
                     SevWarn,

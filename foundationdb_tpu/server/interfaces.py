@@ -83,6 +83,9 @@ class GetKeyServersRequest:
     readRequestServer, MasterProxyServer.actor.cpp:1036)."""
 
     key: bytes = b""
+    # resolve the shard containing the keys immediately BELOW `key` instead
+    # (reverse range reads walk shards right-to-left from the range end)
+    before: bool = False
 
 
 @dataclass
@@ -297,6 +300,10 @@ class RegisterWorkerRequest:
     address: str = ""
     process_class: str = "unset"  # storage | transaction | stateless | unset
     roles: tuple = ()  # role kinds currently hosted (for fitness)
+    # process locality (fdbrpc/Locality.h) for policy-driven placement
+    machine: str = ""
+    zone: str = ""
+    dc: str = "dc0"
 
 
 @dataclass
@@ -309,6 +316,9 @@ class WorkerDetails:
     address: str = ""
     process_class: str = "unset"
     roles: tuple = ()
+    machine: str = ""
+    zone: str = ""
+    dc: str = "dc0"
 
 
 @dataclass

@@ -77,15 +77,33 @@ class LogSystemConfig:
 
 
 def assign_tags(
-    addresses: list[str], log_ids: list[str], n_tags: int, replication: int
+    addresses: list[str],
+    log_ids: list[str],
+    n_tags: int,
+    replication: int,
+    zones: list[str] = None,
 ) -> list[TLogInterface]:
-    """Spread each tag over `replication` distinct tlogs round-robin
-    (the static form of the reference's policy-driven tlog team choice)."""
+    """Spread each tag over `replication` distinct tlogs — across distinct
+    ZONES when the topology allows (the reference's policy-driven tlog
+    team choice, ReplicationPolicy.h PolicyAcross over zoneId); plain
+    round-robin otherwise."""
     assert len(addresses) >= replication, "need >= replication tlogs"
     owned = [set() for _ in addresses]
-    for t in range(n_tags):
-        for r in range(replication):
-            owned[(t + r) % len(addresses)].add(t)
+    by_zone: dict = {}
+    if zones is not None:
+        for i, z in enumerate(zones):
+            by_zone.setdefault(z or addresses[i], []).append(i)
+    if len(by_zone) >= replication:
+        zlist = sorted(by_zone, key=lambda z: (-len(by_zone[z]), z))
+        for t in range(n_tags):
+            for r in range(replication):
+                z = zlist[(t + r) % len(zlist)]
+                grp = by_zone[z]
+                owned[grp[(t // len(zlist)) % len(grp)]].add(t)
+    else:
+        for t in range(n_tags):
+            for r in range(replication):
+                owned[(t + r) % len(addresses)].add(t)
     return [
         TLogInterface(address=a, log_id=i, tags=tuple(sorted(o)))
         for a, i, o in zip(addresses, log_ids, owned)

@@ -263,7 +263,11 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     tlog_workers = picker.pick("tlog", n_tlogs)
     log_ids = [f"log-{recovery_count}-{i}-{uid}" for i in range(n_tlogs)]
     logs = assign_tags(
-        [w.address for w in tlog_workers], log_ids, n_storage, tlog_replication
+        [w.address for w in tlog_workers],
+        log_ids,
+        n_storage,
+        tlog_replication,
+        zones=[getattr(w, "zone", "") for w in tlog_workers],
     )
     await wait_for_all(
         [
@@ -402,6 +406,9 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     dd_db = Database(
         process.sim, client_addr=process.address, proxy_ifaces=list(proxy_ifaces)
     )
+    addr_zone = {
+        w.address: (getattr(w, "zone", "") or w.address) for w in workers
+    }
     dd = DataDistributor(
         process,
         dd_db,
@@ -409,6 +416,7 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         knobs,
         int(config.get("replication", 1)),
         uid=f"dd-{uid}-{recovery_count}",
+        zones={s.tag: addr_zone.get(s.address, s.address) for s in storage},
     )
     rk = Ratekeeper(process, master, storage, knobs, uid)
     watched = (
@@ -454,11 +462,15 @@ class _RolePicker:
 
     def pick(self, role: str, n: int) -> list:
         want = _CLASS_FOR_ROLE.get(role, "stateless")
+        zones_used: dict = {}
 
         def fitness(w):
             return (
                 w.process_class != want,  # matching class first
                 w.address in self.avoid,
+                # spread one pick-call across zones (so e.g. the tlog set
+                # spans failure domains and policy tag assignment works)
+                zones_used.get(getattr(w, "zone", "") or w.address, 0),
                 self.load[w.address],
             )
 
@@ -467,6 +479,8 @@ class _RolePicker:
             w = min(self.workers, key=fitness)
             chosen.append(w)
             self.load[w.address] += 1
+            z = getattr(w, "zone", "") or w.address
+            zones_used[z] = zones_used.get(z, 0) + 1
         return chosen
 
 
@@ -499,10 +513,49 @@ async def _seed_storage(process, picker: _RolePicker, n_storage, replication, m_
         "storage roles need distinct workers (one per process)"
     )
     n_teams = n_storage // replication
+    # zone-aware team formation (DDTeamCollection + ReplicationPolicy.h:119
+    # PolicyAcross): each team spans `replication` distinct zones when the
+    # topology allows it — a "2-replica" cluster must survive losing a
+    # whole zone. Deterministic: round-robin over zones sorted by size.
+    def zkey(w):
+        return w.zone or w.address
+
+    by_zone: dict = {}
+    for i, w in enumerate(workers):
+        by_zone.setdefault(zkey(w), []).append(i)
+    zones = sorted(by_zone, key=lambda z: (-len(by_zone[z]), z))
+    teams = []
+    if len(zones) >= replication:
+        cursors = {z: 0 for z in zones}
+        for t in range(n_teams):
+            members = []
+            for j in range(replication):
+                # find a zone with spare workers, starting at the rotation
+                for probe in range(len(zones)):
+                    zz = zones[(t + j + probe) % len(zones)]
+                    if cursors[zz] < len(by_zone[zz]) and not any(
+                        zkey(workers[m]) == zz for m in members
+                    ):
+                        members.append(by_zone[zz][cursors[zz]])
+                        cursors[zz] += 1
+                        break
+                else:
+                    # zones exhausted under distinctness: take any spare
+                    for zz in zones:
+                        if cursors[zz] < len(by_zone[zz]):
+                            members.append(by_zone[zz][cursors[zz]])
+                            cursors[zz] += 1
+                            break
+            teams.append(sorted(members))
+    else:
+        teams = [
+            list(range(t * replication, (t + 1) * replication))
+            for t in range(n_teams)
+        ]
     bounds = [b""] + _split_points(n_teams) + [None]
     shards = []
     for team in range(n_teams):
-        members = list(range(team * replication, (team + 1) * replication))
+        members = teams[team]
         addrs = tuple(workers[t].address for t in members)
         shards.append((bounds[team], bounds[team + 1], addrs, tuple(members)))
     storage = []

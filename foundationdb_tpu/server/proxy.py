@@ -40,6 +40,7 @@ from ..net.sim import BrokenPromise
 from ..runtime.futures import (
     AsyncTrigger,
     Future,
+    RequestBatcher,
     VersionGate,
     delay,
     wait_for_all,
@@ -116,6 +117,11 @@ class ShardMap:
         begin, end, v = self.map.range_for(key)
         return begin, end, v[0], v[1]
 
+    def team_before_key(self, key: bytes):
+        """(begin, end, addresses, tags) of the shard just below key."""
+        begin, end, v = self.map.range_before(key)
+        return begin, end, v[0], v[1]
+
     def to_list(self) -> list:
         return [
             (b, e, v[0], v[1]) for b, e, v in self.map.ranges() if v is not None
@@ -173,6 +179,9 @@ class Proxy:
         # ratekeeper gate state (None until a getRate reply arrives)
         self._grv_budget = None
         self._grv_replenished = AsyncTrigger()
+        # GRV batching toward the master (transactionStarter batching);
+        # created lazily — self.process is bound at register() time
+        self._grv_batcher = None
 
     # -- GRV -------------------------------------------------------------------
 
@@ -185,10 +194,22 @@ class Proxy:
             self._check_alive()
         if self._grv_budget is not None:
             self._grv_budget -= 1.0
-        # the master's live committed version (reported there before commit
-        # acks reach clients) makes reads causally consistent across proxies
-        live = await self.process.request(self.master.ep("getLiveCommitted"), None)
-        return GetReadVersionReply(version=live.version)
+        # batched: requests that arrived before the master round trip began
+        # share one getLiveCommitted fetch (transactionStarter batching,
+        # MasterProxyServer.actor.cpp:925); arrivals during a flight form
+        # the next batch (RequestBatcher's causality rule).
+        if self._grv_batcher is None:
+            self._grv_batcher = RequestBatcher(
+                self._fetch_live_version, self.process.spawn
+            )
+        version = await self._grv_batcher.join()
+        return GetReadVersionReply(version=version)
+
+    async def _fetch_live_version(self):
+        live = await self.process.request(
+            self.master.ep("getLiveCommitted"), None
+        )
+        return live.version
 
     async def rate_poller(self):
         """Poll the master's ratekeeper (getRate:85); no ratekeeper (the
@@ -218,7 +239,10 @@ class Proxy:
 
     async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
         self._check_alive()
-        begin, end, team, tags = self.shards.team_for_key(req.key)
+        if getattr(req, "before", False):
+            begin, end, team, tags = self.shards.team_before_key(req.key)
+        else:
+            begin, end, team, tags = self.shards.team_for_key(req.key)
         return GetKeyServersReply(
             begin=begin, end=end, team=list(team), tags=list(tags)
         )

@@ -140,6 +140,9 @@ class Worker:
                                 address=self.process.address,
                                 process_class=self.process_class,
                                 roles=tuple(h.kind for h in self.roles.values()),
+                                machine=self.process.locality.machine,
+                                zone=self.process.locality.zone,
+                                dc=self.process.locality.dc,
                             ),
                         ),
                         self.knobs.HEARTBEAT_INTERVAL * 2,

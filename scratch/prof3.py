@@ -1,0 +1,255 @@
+"""Round-3 phase profile of the grid kernel at bench shape.
+
+Each phase runs inside a lax.scan whose iterations are serially
+data-dependent (state threads through, or the carry perturbs an input the
+phase actually reads), so XLA cannot hoist the body. Per-iteration cost =
+slope between scan lengths 8 and 72, which cancels the axon tunnel's
+~65ms blocked-dispatch floor.
+
+Run without PYTHONPATH overrides (axon plugin needs /root/.axon_site).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+import bench as B
+
+BATCHES = 24
+TXNS = 2500
+WINDOW = 50
+
+print("devices:", jax.devices())
+batches = B.make_batches(BATCHES, TXNS)
+cap = 1 << 19
+tpu = TpuConflictSet(key_width=12, capacity=cap)
+encs = [tpu.encode(txs) for txs in batches]
+tpu.detect_many_encoded([(encs[i], i + WINDOW, i) for i in range(8)])
+state = tpu._state
+print("grid shape:", state.grid.shape, "count sum:", int(np.asarray(state.count).sum()))
+
+b, n, _ = encs[10]
+batch = G.Batch(*[jnp.asarray(x) for x in b])
+T, KR, L = batch.rb.shape
+print("batch:", batch.rb.shape, batch.wb.shape)
+
+now = jnp.int32(10 + WINDOW - tpu._base)
+old = jnp.int32(max(10 - tpu._base, 0))
+
+B_, S, Lp1 = state.grid.shape
+Lk = Lp1 - 1
+KW = batch.wb.shape[1]
+Wtot = T * KW
+
+
+def slope(name, make_run):
+    """make_run(n) -> zero-arg callable; time n=8 vs n=72, report slope."""
+    runs = {n: make_run(n) for n in (8, 72)}
+    t0 = time.time()
+    jax.block_until_ready(runs[8]())
+    ct = time.time() - t0
+    jax.block_until_ready(runs[72]())
+
+    def rep(n):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.time()
+            jax.block_until_ready(runs[n]())
+            best = min(best, time.time() - t0)
+        return best
+
+    t8, t72 = rep(8), rep(72)
+    dt = (t72 - t8) / 64 * 1000
+    print(f"{name:44s} {dt:8.3f} ms/iter   (compile {ct:.1f}s, floor {t8*1000:.1f}ms)")
+    return dt
+
+
+def scan_state(name, step_fn):
+    """step_fn(state) -> new GridState-like pytree; thread it."""
+
+    def make_run(n):
+        @jax.jit
+        def run(st):
+            def step(st, _):
+                return step_fn(st), None
+
+            out, _ = jax.lax.scan(step, st, None, length=n)
+            return out
+
+        return lambda: run(state)
+
+    return slope(name, make_run)
+
+
+def scan_carry(name, fn):
+    """fn(c) -> int32 scalar, must genuinely consume c."""
+
+    def make_run(n):
+        @jax.jit
+        def run(c0):
+            def step(c, _):
+                return fn(c), None
+
+            out, _ = jax.lax.scan(step, c0, None, length=n)
+            return out
+
+        return lambda: run(jnp.int32(0))
+
+    return slope(name, make_run)
+
+
+def fold(out):
+    s = jnp.int32(0)
+    for leaf in jax.tree_util.tree_leaves(out):
+        s = s ^ leaf.reshape(-1)[0].astype(jnp.int32)
+    return s
+
+
+# ---- top-level phases ----
+
+def full_step(st):
+    st2, verdicts, pressure = G._resolve_one(st, batch, now, old, old)
+    return st2
+
+scan_state("FULL _resolve_one (state thread)", full_step)
+
+
+def hist_intra(c):
+    b2 = batch._replace(t_snap=batch.t_snap + (c & 1))
+    H = G.history_conflicts(state, b2)
+    commit = G.intra_batch_commits(b2, H)
+    return fold(commit)
+
+scan_carry("history + intra (carry chain)", hist_intra)
+
+
+def hist_only(c):
+    b2 = batch._replace(t_snap=batch.t_snap + (c & 1))
+    return fold(G.history_conflicts(state, b2))
+
+scan_carry("history_conflicts", hist_only)
+
+
+H_dev = jax.jit(G.history_conflicts)(state, batch)
+commit_dev = jax.jit(G.intra_batch_commits)(batch, H_dev)
+
+
+def merge_step(st):
+    st2, pressure = G.merge_writes(st, batch, commit_dev, now, old)
+    return st2
+
+scan_state("merge_writes (state thread)", merge_step)
+
+
+# ---- merge components, state-threaded where possible ----
+
+def merge_flatsort_only(st):
+    w_ok = G.lex_lt(batch.wb, batch.we) & commit_dev[:, None]
+    c = batch.wb.reshape(Wtot, Lk)
+    d = batch.we.reshape(Wtot, Lk)
+    ok = w_ok.reshape(Wtot)
+    bc = G._rank_le(c, st.pivots)
+    bd = G._rank_le(d, st.pivots)
+    codes = jnp.concatenate([c, d], axis=0)
+    evs = jnp.concatenate([jnp.where(ok, 1, 0), jnp.where(ok, -1, 0)]).astype(jnp.int32)
+    bkt = jnp.where(jnp.concatenate([ok, ok]), jnp.concatenate([bc, bd]), B_).astype(jnp.int32)
+    cols = (bkt,) + tuple(codes[:, i] for i in range(Lk)) + (evs,)
+    s = jax.lax.sort(cols, num_keys=Lk + 1)
+    return st._replace(count=st.count + (s[0].reshape(-1)[0] & 0x1))
+
+scan_state("merge comp: rank+flatsort", merge_flatsort_only)
+
+
+def merge_carry_only(st):
+    w_ok = G.lex_lt(batch.wb, batch.we) & commit_dev[:, None]
+    ok = w_ok.reshape(Wtot)
+    bc = G._rank_le(batch.wb.reshape(Wtot, Lk), st.pivots)
+    bd = G._rank_le(batch.we.reshape(Wtot, Lk), st.pivots)
+    evs = jnp.concatenate([jnp.where(ok, 1, 0), jnp.where(ok, -1, 0)]).astype(jnp.int32)
+    bkt = jnp.where(jnp.concatenate([ok, ok]), jnp.concatenate([bc, bd]), B_).astype(jnp.int32)
+    ar = jnp.arange(B_, dtype=jnp.int32)[None, :]
+    evsum = jnp.sum(jnp.where(bkt[:, None] == ar, evs[:, None], 0), axis=0)
+    carry = jnp.cumsum(evsum)
+    return st._replace(count=st.count ^ (carry & 0x1))
+
+scan_state("merge comp: carry [2W,B]+cumsum(B)", merge_carry_only)
+
+
+def merge_bigsort_only(st):
+    old_bnd = st.grid[..., :Lk]
+    m_code = jnp.concatenate([old_bnd, old_bnd], axis=1)
+    m_ver = jnp.concatenate([st.grid[..., Lk].astype(jnp.int32)] * 2, axis=1)
+    cols = tuple(m_code[..., i] for i in range(Lk)) + (m_ver,)
+    s = jax.lax.sort(cols, dimension=1, num_keys=Lk + 1)
+    return st._replace(bmax=st.bmax ^ (s[Lk][:, 0] & 1))
+
+scan_state("merge comp: per-bucket sort [B,2S]", merge_bigsort_only)
+
+
+def merge_fill_only(st):
+    v = jnp.concatenate([st.grid[..., Lk].astype(jnp.int32)] * 2, axis=1)
+    h = v > 0
+    f = G._log_shift_fill(v, h)
+    return st._replace(bmax=st.bmax ^ (f[:, -1] & 1))
+
+scan_state("merge comp: log_shift_fill [B,2S]", merge_fill_only)
+
+
+def merge_compact_only(st):
+    m_code = jnp.concatenate([st.grid[..., :Lk]] * 2, axis=1)
+    nv = jnp.concatenate([st.grid[..., Lk].astype(jnp.int32)] * 2, axis=1)
+    keep = nv > 0
+    cols = (jnp.where(keep, 0, 1).astype(jnp.int32),) + tuple(
+        m_code[..., i] for i in range(Lk)
+    ) + (nv,)
+    s = jax.lax.sort(cols, dimension=1, num_keys=1, is_stable=True)
+    return st._replace(bmax=st.bmax ^ (s[1][:, 0].astype(jnp.int32) & 1))
+
+scan_state("merge comp: compact sort [B,2S] 1key", merge_compact_only)
+
+
+# ---- candidate blocks: touched-bucket merge at various [U, SS] ----
+
+for U, SS in [(4096, 24), (4096, 40), (4096, 88), (8192, 24), (16384, 128)]:
+    key_cols = [
+        jax.random.randint(jax.random.PRNGKey(i), (U, SS), 0, 1 << 30, dtype=jnp.int32)
+        for i in range(Lk + 1)
+    ]
+
+    def make_run(n, key_cols=key_cols):
+        @jax.jit
+        def run(cols):
+            def step(cols, _):
+                s = jax.lax.sort(tuple(cols), dimension=1, num_keys=Lk + 1)
+                return list(s), None
+
+            out, _ = jax.lax.scan(step, cols, None, length=n)
+            return out[0]
+
+        return lambda: run(key_cols)
+
+    slope(f"cand: sort [U={U},{SS}] {Lk+1}key", make_run)
+
+
+def gather_step(st):
+    idx = (st.count[:4096] + jnp.arange(4096, dtype=jnp.int32) * 3) % B_
+    g = st.grid[idx]
+    return st._replace(count=st.count ^ (g[:, 0, 0].astype(jnp.int32)[0] & 1))
+
+scan_state("cand: gather 4096xS bucket rows", gather_step)
+
+
+def scatter_step(st):
+    idx = (st.count[:4096] + jnp.arange(4096, dtype=jnp.int32) * 7) % B_
+    rows = st.grid[:4096]
+    g = st.grid.at[idx].set(rows)
+    return st._replace(grid=g)
+
+scan_state("cand: gather+scatter 4096xS rows", scatter_step)

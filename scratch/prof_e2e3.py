@@ -1,0 +1,90 @@
+"""Where do the bench's 21ms/batch go? Instrument host-side phases."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+import numpy as np
+import jax
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict import tpu_backend as TB
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+import bench as B
+
+BATCHES = 200
+TXNS = 2500
+WINDOW = 50
+GROUP = 20
+
+batches = B.make_batches(BATCHES, TXNS)
+cap = 1 << 19
+tpu = TpuConflictSet(key_width=12, capacity=cap)
+t0 = time.time()
+encs = [tpu.encode(txs) for txs in batches]
+print(f"encode: {(time.time()-t0)/BATCHES*1000:.2f} ms/batch")
+
+# count reshards
+orig_reshard = tpu._reshard
+reshard_calls = []
+def counting_reshard(*a, **k):
+    t0 = time.time()
+    orig_reshard(*a, **k)
+    reshard_calls.append((time.time() - t0, k.get('grow', a[1] if len(a)>1 else False), tpu._B))
+tpu._reshard = counting_reshard
+
+# instrument _stack and _dispatch
+orig_stack = tpu._stack
+stack_time = [0.0]
+def timed_stack(bs):
+    t0 = time.time()
+    r = orig_stack(bs)
+    stack_time[0] += time.time() - t0
+    return r
+tpu._stack = timed_stack
+
+orig_dispatch = tpu._dispatch
+disp_time = [0.0]
+def timed_dispatch(g):
+    t0 = time.time()
+    orig_dispatch(g)
+    disp_time[0] += time.time() - t0
+tpu._dispatch = timed_dispatch
+
+# warmup
+warm = [(encs[i], i + WINDOW, i) for i in range(GROUP)]
+t0 = time.time()
+tpu.detect_many_encoded(warm)
+print(f"warmup+compile: {time.time()-t0:.1f}s; reshards so far {len(reshard_calls)}")
+stack_time[0] = 0.0
+disp_time[0] = 0.0
+n_resh0 = len(reshard_calls)
+
+t0 = time.time()
+handles = []
+outs = []
+coll_times = []
+t_disp = 0.0
+t_coll0 = time.time()
+for g in range(GROUP, BATCHES, GROUP):
+    if len(handles) >= 3:
+        tc = time.time()
+        outs.extend(handles.pop(0)())
+        coll_times.append(time.time() - tc)
+    td = time.time()
+    work = [(encs[i], i + WINDOW, i) for i in range(g, min(g + GROUP, BATCHES))]
+    handles.append(tpu.detect_many_encoded_async(work))
+    t_disp += time.time() - td
+for h in handles:
+    tc = time.time()
+    outs.extend(h())
+    coll_times.append(time.time() - tc)
+t_coll = time.time() - t_coll0
+total = time.time() - t0
+nb = BATCHES - GROUP
+print(f"timed region: {total:.2f}s for {nb} batches = {total/nb*1000:.2f} ms/batch")
+print(f"  dispatch loop: {t_disp:.2f}s (stack {stack_time[0]:.2f}s, device-call {disp_time[0]:.2f}s)")
+print(f"  collect loop:  {t_coll:.2f}s  per-group: {[f'{c*1000:.0f}ms' for c in coll_times]}")
+print(f"  reshards in timed region: {len(reshard_calls)-n_resh0}, times {[f'{r:.2f}s' for r in reshard_calls[n_resh0:]]}")
+print(f"  count sum: {int(np.asarray(tpu._state.count).sum())}, B={tpu._B}")

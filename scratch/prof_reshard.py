@@ -1,0 +1,55 @@
+"""Why is _reshard still ~2s, and what pressure triggers it?"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+import numpy as np
+import jax
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict import keys as K
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+import bench as B
+
+BATCHES = 100
+TXNS = 2500
+WINDOW = 50
+GROUP = 20
+
+batches = B.make_batches(BATCHES, TXNS)
+cap = 1 << 19
+tpu = TpuConflictSet(key_width=12, capacity=cap)
+encs = [tpu.encode(txs) for txs in batches]
+
+# run groups, printing pressure each collect
+orig_collect = tpu._collect
+def loud_collect(group):
+    r = orig_collect(group)
+    return r
+import foundationdb_tpu.conflict.tpu_backend as TB
+
+for g in range(0, BATCHES, GROUP):
+    work = [(encs[i], i + WINDOW, i) for i in range(g, min(g + GROUP, BATCHES))]
+    h = tpu.detect_many_encoded_async(work)
+    h()
+    pr = "collected"
+    print(f"group {g//GROUP}: B={tpu._B} count_sum={int(np.asarray(tpu._state.count).sum())} "
+          f"count_max={int(np.asarray(tpu._state.count).max())}")
+
+# now time the pieces of a reshard at this state
+state = tpu._state
+t0 = time.time(); codes, vers = G.live_rows(state); print(f"live_rows: {time.time()-t0:.3f}s N={len(codes)}")
+t0 = time.time(); enc = K.encode_keys(tpu._sample, tpu._width); print(f"encode sample({len(tpu._sample)}): {time.time()-t0:.3f}s")
+t0 = time.time()
+allc = np.concatenate([codes, enc])
+keys = G.codes_to_bytes(np.ascontiguousarray(allc))
+_, uniq_idx = np.unique(keys, return_index=True)
+cands = allc[uniq_idx]
+cands = cands[cands.any(axis=1)]
+print(f"unique: {time.time()-t0:.3f}s cands={len(cands)}")
+from foundationdb_tpu.conflict.tpu_backend import _pick_pivots
+t0 = time.time(); piv = _pick_pivots(cands, tpu._B, tpu._lanes); print(f"pick_pivots: {time.time()-t0:.3f}s P={len(piv)}")
+t0 = time.time(); st = G.reshard_host(state, piv, tpu._B, tpu._S); print(f"reshard_host: {time.time()-t0:.3f}s")
+t0 = time.time(); jax.block_until_ready(st.grid); print(f"device upload: {time.time()-t0:.3f}s grid {st.grid.shape}")

@@ -1,0 +1,174 @@
+"""Locality + replication policies (fdbrpc/Locality.h,
+fdbrpc/ReplicationPolicy.h:99-160): policy combinators, zone-aware team
+building, and the acid test — kill an ENTIRE zone of a 3-zone
+double-replicated cluster and lose nothing."""
+
+import pytest
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.runtime.locality import (
+    Locality,
+    PolicyAcross,
+    PolicyAnd,
+    PolicyOne,
+    policy_for,
+)
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.server.log_system import assign_tags
+
+
+# -- policy combinators -------------------------------------------------------
+
+
+def L(m, z=None, dc="dc0"):
+    return Locality.of(m, zone=z, dc=dc)
+
+
+def test_policy_one():
+    p = PolicyOne()
+    assert p.validate([L("m1")])
+    assert p.select([("a", L("m1"))]) == ["a"]
+    assert p.select([]) is None
+
+
+def test_policy_across_zones():
+    p = PolicyAcross(2, "zone")
+    assert p.validate([L("m1", "z1"), L("m2", "z2")])
+    assert not p.validate([L("m1", "z1"), L("m2", "z1")])
+    picked = p.select(
+        [
+            ("a", L("m1", "z1")),
+            ("b", L("m2", "z1")),
+            ("c", L("m3", "z2")),
+        ]
+    )
+    assert picked is not None and len(picked) == 2
+    zones = {"a": "z1", "b": "z1", "c": "z2"}
+    assert len({zones[i] for i in picked}) == 2
+    # impossible: only one zone
+    assert p.select([("a", L("m1", "z1")), ("b", L("m2", "z1"))]) is None
+
+
+def test_policy_across_nested():
+    # 2 DCs, each with 2 distinct zones inside
+    p = PolicyAcross(2, "dc", PolicyAcross(2, "zone"))
+    cands = [
+        ("a", L("m1", "z1", "dc1")),
+        ("b", L("m2", "z2", "dc1")),
+        ("c", L("m3", "z3", "dc2")),
+        ("d", L("m4", "z4", "dc2")),
+    ]
+    picked = p.select(cands)
+    assert picked is not None and len(picked) == 4
+    assert p.replicas() == 4
+    assert p.validate([l for _i, l in cands])
+    assert not p.validate(
+        [L("m1", "z1", "dc1"), L("m2", "z2", "dc1"), L("m3", "z3", "dc1")]
+    )
+
+
+def test_policy_and():
+    p = PolicyAnd([PolicyAcross(2, "zone"), PolicyAcross(2, "machine")])
+    cands = [
+        ("a", L("m1", "z1")),
+        ("b", L("m2", "z2")),
+    ]
+    picked = p.select(cands)
+    assert picked is not None
+    assert p.validate([L("m1", "z1"), L("m2", "z2")])
+
+
+def test_policy_for():
+    assert isinstance(policy_for(1), PolicyOne)
+    p = policy_for(3)
+    assert isinstance(p, PolicyAcross) and p.n == 3
+
+
+def test_assign_tags_across_zones():
+    addrs = [f"t{i}" for i in range(4)]
+    zones = ["z0", "z0", "z1", "z1"]
+    logs = assign_tags(addrs, [f"l{i}" for i in range(4)], 8, 2, zones=zones)
+    zone_of = dict(zip(addrs, zones))
+    # every tag's replicas span two zones
+    holders: dict = {}
+    for log in logs:
+        for t in log.tags:
+            holders.setdefault(t, []).append(log.address)
+    for t, hs in holders.items():
+        assert len(hs) == 2
+        assert len({zone_of[h] for h in hs}) == 2, (t, hs)
+
+
+# -- end-to-end: zone kill ----------------------------------------------------
+
+
+def run(sim, coro, limit=600.0):
+    sim.activate()
+    fut = spawn(coro)
+    return sim.run_until_done(fut, limit)
+
+
+def test_zone_kill_loses_nothing():
+    """3 zones, 6 storage, 2× replication: every team spans two zones, so
+    killing every process in one zone leaves at least one live replica of
+    every shard; after recovery all data is readable and writable."""
+    sim = Sim(seed=21)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(n_storage=6, replication=2, n_tlogs=3, tlog_replication=2),
+        n_coordinators=3,
+        n_zones=3,
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def go():
+        keys = [b"zk%03d" % i for i in range(40)]
+
+        async def fill(tr):
+            for i, k in enumerate(keys):
+                tr.set(k, b"v%d" % i)
+
+        await db.run(fill)
+
+        # all storage teams must span two zones
+        await delay(2.0)
+        # find the master's shard map via a fresh location scan
+        zones_of_team = []
+        for k in (b"", b"\x40", b"\x80", b"\xc0"):
+            b, e, team = await db._locate(k)
+            zs = {sim.processes[a].locality.zone for a in team}
+            zones_of_team.append((team, zs))
+            assert len(zs) == len(team), (team, zs)
+
+        killed = sim.kill_zone("z0")
+        assert killed, "zone z0 had processes"
+
+        # survive: reads + writes continue after recovery
+        db2 = Database.from_coordinators(
+            sim, cluster.coordinators, client_addr="client2"
+        )
+
+        async def check(tr):
+            out = []
+            for k in keys:
+                out.append(await tr.get(k))
+            return out
+
+        vals = await db2.run(check)
+        assert vals == [b"v%d" % i for i in range(len(keys))]
+
+        async def write_more(tr):
+            tr.set(b"after-kill", b"yes")
+
+        await db2.run(write_more)
+
+        async def read_back(tr):
+            return await tr.get(b"after-kill")
+
+        assert await db2.run(read_back) == b"yes"
+        return True
+
+    assert run(sim, go())
