@@ -112,9 +112,93 @@ def bench_range_index():
     )
 
 
+def bench_e2e():
+    """BENCH_COMPONENT=e2e: whole-system commit throughput + latency — N
+    clients through client→proxy→resolver→tlog→storage in simulation
+    (BASELINE.md's concurrent-writes shape: many clients, 10 keys/txn).
+
+    Reports wall-clock txn/s (host work of the full pipeline) and p50/p95
+    commit latency in SIM time (the model-time cost of batching and the
+    5-phase pipeline — the analog of the reference's 1.5-2.5 ms commit
+    budget, performance.rst:36). BENCH_E2E_BACKEND picks the resolver's
+    conflict backend (default tpu; oracle/native for comparison)."""
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.net.sim import Sim
+    from foundationdb_tpu.runtime.futures import spawn, wait_for_all
+    from foundationdb_tpu.runtime.loop import now as sim_now
+    from foundationdb_tpu.server import Cluster, ClusterConfig
+
+    backend = os.environ.get("BENCH_E2E_BACKEND", "tpu")
+    n_clients = int(os.environ.get("BENCH_E2E_CLIENTS", "50"))
+    n_txns = int(os.environ.get("BENCH_E2E_TXNS", "40"))
+    keyspace = int(os.environ.get("BENCH_E2E_KEYSPACE", "100000"))
+
+    sim = Sim(seed=0)
+    sim.activate()
+    cluster = Cluster(
+        sim, ClusterConfig(n_proxies=2, n_resolvers=2, conflict_backend=backend)
+    )
+    db = Database(sim, cluster.proxy_addrs)
+    rnd = random.Random(7)
+    latencies = []
+
+    committed = [0]
+
+    async def client(cid):
+        for t in range(n_txns):
+            for attempt in range(20):
+                tr = db.transaction()
+                try:
+                    for _ in range(10):
+                        k = b"%06d" % rnd.randrange(keyspace)
+                        tr.set(k, b"c%d-%d" % (cid, t))
+                    t0 = sim_now()
+                    await tr.commit()
+                    latencies.append(sim_now() - t0)
+                    committed[0] += 1
+                    break
+                except Exception as e:
+                    await tr.on_error(e)
+        return True
+
+    async def go():
+        return await wait_for_all([spawn(client(c)) for c in range(n_clients)])
+
+    t0 = time.time()
+    oks = sim.run_until_done(spawn(go()), 3600.0)
+    wall = time.time() - t0
+    assert all(oks)
+    total = committed[0]
+    assert total == len(latencies)
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2] * 1000
+    p95 = latencies[int(len(latencies) * 0.95)] * 1000
+    tps = total / wall
+    log(
+        f"e2e[{backend}]: {total} txns in {wall:.2f}s wall = {tps:.0f} txn/s; "
+        f"commit latency p50 {p50:.2f}ms p95 {p95:.2f}ms (sim time)"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "e2e_commit_throughput",
+                "value": round(tps, 1),
+                "unit": "txn/s",
+                "vs_baseline": round(tps / 46000.0, 4),
+                "p50_commit_ms_simtime": round(p50, 2),
+                "p95_commit_ms_simtime": round(p95, 2),
+                "backend": backend,
+            }
+        )
+    )
+
+
 def main():
     if os.environ.get("BENCH_COMPONENT") == "range_index":
         bench_range_index()
+        return
+    if os.environ.get("BENCH_COMPONENT") == "e2e":
+        bench_e2e()
         return
     from foundationdb_tpu.conflict.native import NativeConflictSet
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet

@@ -28,6 +28,7 @@ from ..runtime.futures import (
     wait_for_any,
 )
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from ..kv.keyrange_map import KeyRangeMap
 from ..server.interfaces import (
     GetKeyServersRequest,
@@ -151,6 +152,8 @@ class Database:
         return await self._grv_batcher.join()
 
     async def _fetch_grv(self) -> int:
+        if buggify():
+            await delay(0.001)  # GRV straggler (batcher forms bigger batches)
         reply = await self._proxy_request(Tokens.GRV, GetReadVersionRequest())
         return reply.version
 

@@ -34,6 +34,7 @@ from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay
+from ..runtime.buggify import buggify
 from ..server.interfaces import (
     CommitRequest,
     GetKeyValuesRequest,
@@ -278,6 +279,8 @@ class Transaction:
         version = await self.get_read_version()
         s_begin, s_end, _team = await self.db._locate(lo)
         chunk_hi = hi if s_end is None else min(hi, s_end)
+        if buggify():
+            limit = 1  # one-row windows: worst-case RYW window merging
         req = GetKeyValuesRequest(begin=lo, end=chunk_hi, version=version, limit=limit)
         reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
         if reply.more:
@@ -310,6 +313,8 @@ class Transaction:
         the location cache — NativeAPI's handling in getValue/getRange."""
         version_retries = 0
         last_err: Exception = None
+        if buggify():
+            self.db.invalidate_cache(key)  # stale-location path every read
         for attempt in range(MAX_READ_ATTEMPTS):
             _b, _e, team = await self.db._locate(key)
             order = list(range(len(team)))
@@ -350,6 +355,8 @@ class Transaction:
             write_conflict_ranges=_dedup(self._wcr),
             mutations=self._mutations,
         )
+        if buggify():
+            await delay(0.002)  # commit racing a concurrent writer
         try:
             reply = await self.db._proxy_request(
                 Tokens.COMMIT, CommitRequest(transaction=data), retry=False

@@ -110,6 +110,26 @@ def lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
     return ~lex_lt(b, a)
 
 
+def searchsorted_lex(sorted_arr: jax.Array, q: jax.Array, side: str) -> jax.Array:
+    """Vectorized binary search over a lex-sorted [P, L] array (used by
+    the storage read path's batched range index, ops/range_index.py).
+
+    side='right': first index with sorted_arr[i] >  q  (#elements <= q)
+    side='left' : first index with sorted_arr[i] >= q  (#elements <  q)
+    """
+    P = sorted_arr.shape[0]
+    steps = max(1, int(np.ceil(np.log2(P))) + 1)
+    lo = jnp.zeros(q.shape[:-1], dtype=jnp.int32)
+    hi = jnp.full(q.shape[:-1], P, dtype=jnp.int32)
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        row = sorted_arr[mid]  # gather [..., L]
+        go_right = lex_le(row, q) if side == "right" else lex_lt(row, q)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    return lo
+
+
 def _split_factors(n: int) -> tuple[int, int]:
     """n = B1 * B2 with both powers of two, B1 >= B2 (n must be a power
     of two)."""
@@ -227,7 +247,7 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
 # Phase 2: intra-batch greedy commit (dense Pji + MXU fixpoint)
 
 
-def intra_batch_commits(batch: Batch, H: jax.Array) -> jax.Array:
+def intra_batch_commits(batch: Batch, H: jax.Array, combine_pji=None) -> jax.Array:
     T, KR, L = batch.rb.shape
     KW = batch.wb.shape[1]
     # one [T, T, KW] compare per read slot: program size grows with KR
@@ -242,6 +262,11 @@ def intra_batch_commits(batch: Batch, H: jax.Array) -> jax.Array:
         # read j overlaps write i: rb_j < we_i and wb_i < re_j
         o = lex_lt(rb, we) & lex_lt(wb, re)  # [T, T, KW]
         Pji = Pji | o.any(axis=2)
+    if combine_pji is not None:
+        # sharded resolver: each partition sees only its clipped ranges;
+        # any genuine overlap survives clipping in at least one partition,
+        # so a pmax across the mesh reconstructs the global matrix
+        Pji = combine_pji(Pji)
     earlier = jnp.arange(T)[None, :] < jnp.arange(T)[:, None]
     Pf = (Pji & earlier).astype(jnp.bfloat16)
 
@@ -631,9 +656,10 @@ def reshard_device(
     pvalid = i <= n_piv
     idx = jnp.where(pvalid, jnp.minimum(idx, N - 1), N - 1)
     pcode = jnp.where(pvalid[:, None], lcode[idx], SENTINEL)
-    new_pivots = jnp.concatenate(
-        [jnp.zeros((1, L), jnp.uint32), pcode], axis=0
-    )
+    # pivot 0 = the smallest live boundary (by the slot-0 invariant this
+    # is the state's existing lower bound: the zero code for a full-range
+    # grid, the partition's lower bound for a sharded resolver's shard)
+    new_pivots = jnp.concatenate([lcode[0:1], pcode], axis=0)
 
     # permute rows into new buckets. No ranking needed: pivots are drawn
     # FROM the sorted live rows, so row j's bucket = #(pivot indices <= j)

@@ -105,6 +105,12 @@ class TpuConflictSet(ConflictSet):
             [(self.encode(txs), now, old) for txs, now, old in work]
         )
 
+    def prepare(self, now: int) -> None:
+        """Call before encode() when driving the encoded/async path
+        directly: rebases the int32 version origin when ``now`` drifts far
+        from the base (flushes in-flight work first)."""
+        self._maybe_rebase(now)
+
     def encode(self, transactions: list[CommitTransaction]):
         """Pre-encode a batch for detect_many_encoded. Encodings are
         base-relative: a version rebase invalidates them (epoch stamp)."""

@@ -90,6 +90,11 @@ class DiskQueue:
 
     async def commit(self) -> None:
         """Make all pushed entries (and any pop) durable."""
+        from ..runtime.buggify import buggify
+        from ..runtime.futures import delay
+
+        if buggify():
+            await delay(0.002)  # slow fsync (stalls the commit quorum)
         while self._flip_pending is not None:
             # a compaction has swapped files but not yet flipped the meta
             # record: committing (and acking!) into the new file before
@@ -110,6 +115,17 @@ class DiskQueue:
         if self._pop_dirty:
             await self._write_meta()
             self._pop_dirty = False
+
+    async def read_entry(self, offset: int, end: int) -> bytes:
+        """Read back one pushed entry by its [offset, end) coordinates —
+        the tlog's spill-by-reference path (spilled payloads live only
+        here). CRC-checked; the entry must have been committed."""
+        raw = await self._file.read(offset, end - offset)
+        length, crc = _ENTRY_HDR.unpack_from(raw, 0)
+        payload = raw[_ENTRY_HDR.size : _ENTRY_HDR.size + length]
+        if len(payload) != length or zlib.crc32(payload) != crc:
+            raise IOError(f"diskqueue {self.name}: bad entry at {offset}")
+        return payload
 
     def pop(self, upto_offset: int) -> None:
         if upto_offset > self._popped:

@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
 from ..runtime.locality import Locality
+from ..runtime.buggify import buggify
 from ..runtime.knobs import Knobs
 from ..runtime.loop import EventLoop, TaskPriority, set_loop
 from ..runtime.trace import SevInfo, SevWarn, trace
@@ -73,9 +74,17 @@ class SimProcess:
 class Sim:
     """One simulated cluster world bound to one event loop."""
 
-    def __init__(self, seed: int = 0, knobs: Optional[Knobs] = None):
+    def __init__(
+        self, seed: int = 0, knobs: Optional[Knobs] = None, chaos: bool = False
+    ):
         self.loop = EventLoop(seed)
         self.knobs = knobs or Knobs()
+        # chaos=True arms BUGGIFY sites (flow/flow.h:60) with this sim's
+        # seeded rng; activate() installs it so concurrent test sims
+        # cannot cross-contaminate
+        from ..runtime.buggify import Buggify
+
+        self.buggify = Buggify(self.loop.random.fork() if chaos else None)
         self.processes: dict[str, SimProcess] = {}
         self.disks: dict[str, Any] = {}  # machine → SimDisk (survives reboot)
         self._clogged_until: dict[tuple[str, str], float] = {}
@@ -117,6 +126,8 @@ class Sim:
     # -- messaging ------------------------------------------------------------
 
     def _latency(self) -> float:
+        if buggify():
+            return self.knobs.SIM_MAX_LATENCY * 10  # network hiccup
         k = self.knobs
         return k.SIM_MIN_LATENCY + self.loop.random.random01() * (
             k.SIM_MAX_LATENCY - k.SIM_MIN_LATENCY
@@ -230,6 +241,9 @@ class Sim:
 
     def activate(self) -> None:
         set_loop(self.loop)
+        from ..runtime.buggify import set_buggify
+
+        set_buggify(self.buggify)
 
     def run(self, until: float = float("inf"), stop_when=None) -> float:
         self.activate()

@@ -57,13 +57,13 @@ class TpuRangeIndex:
     def _fn_for(self, qshape: int):
         fn = self._lookup_jit.get(qshape)
         if fn is None:
-            from ..conflict.tpu_index import _searchsorted
+            from ..conflict.grid import searchsorted_lex
 
             jax = self._jax
 
             def kernel(codes, q):
-                lo = _searchsorted(codes, q, side="left")
-                hi = _searchsorted(codes, q, side="right")
+                lo = searchsorted_lex(codes, q, side="left")
+                hi = searchsorted_lex(codes, q, side="right")
                 return lo, hi
 
             fn = self._lookup_jit[qshape] = jax.jit(kernel)

@@ -55,7 +55,11 @@ class Knobs:
         }
 
     def randomize(self, rng) -> None:
-        """Buggify-style knob randomization for simulation runs."""
+        """Buggify-style knob randomization for simulation runs (the
+        reference randomizes knob defaults per sim run — BUGGIFY-aware
+        defaults in */Knobs.cpp). Every choice is a legal configuration;
+        extreme values exist to force rare paths (tiny batches, tiny spill
+        thresholds, aggressive timeouts)."""
         if rng.coinflip(0.25):
             self.COMMIT_BATCH_INTERVAL = rng.random_choice([0.0005, 0.002, 0.01])
         if rng.coinflip(0.25):
@@ -64,3 +68,25 @@ class Knobs:
             self.MAX_BATCH_TXNS = rng.random_choice([8, 64, 1024])
         if rng.coinflip(0.25):
             self.CONFLICT_SET_CAPACITY = rng.random_choice([16, 256, 1 << 12])
+        if rng.coinflip(0.25):
+            self.MAX_COMMIT_BATCH_INTERVAL = rng.random_choice([0.02, 0.1, 0.25])
+        if rng.coinflip(0.25):
+            self.TLOG_SPILL_THRESHOLD = rng.random_choice([256, 4096, 1 << 20])
+        if rng.coinflip(0.25):
+            self.STORAGE_DURABILITY_LAG = rng.random_choice([0.05, 0.5, 1.5])
+        if rng.coinflip(0.25):
+            self.STORAGE_FETCH_KEYS_BATCH = rng.random_choice([2, 64, 10_000])
+        if rng.coinflip(0.25):
+            self.HEARTBEAT_INTERVAL = rng.random_choice([0.2, 0.5, 1.0])
+        if rng.coinflip(0.25):
+            self.FAILURE_TIMEOUT = rng.random_choice([1.0, 2.0, 4.0])
+        if rng.coinflip(0.25):
+            self.CLIENT_MAX_RETRY_DELAY = rng.random_choice([0.2, 1.0])
+        # coupled constraint: the failure detector must tolerate several
+        # heartbeat periods (including a buggify-doubled one), or workers
+        # flap out of the registry and recruitment never settles
+        self.FAILURE_TIMEOUT = max(
+            self.FAILURE_TIMEOUT, self.HEARTBEAT_INTERVAL * 4
+        )
+        if rng.coinflip(0.25):
+            self.SIM_MAX_LATENCY = rng.random_choice([0.001, 0.003, 0.02])

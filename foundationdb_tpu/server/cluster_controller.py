@@ -16,6 +16,7 @@ from __future__ import annotations
 from ..net.sim import Endpoint
 from ..runtime.futures import AsyncVar, delay, timeout
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from ..runtime.loop import now
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
@@ -76,6 +77,8 @@ class ClusterController:
     # -- worker registry --------------------------------------------------------
 
     async def register_worker(self, req: RegisterWorkerRequest):
+        if buggify():
+            await delay(0.01)  # slow registry (recruitment sees stale sets)
         self.workers[req.address] = (
             WorkerDetails(
                 address=req.address,

@@ -34,6 +34,7 @@ from ..runtime.futures import (
     wait_for_any,
 )
 from ..runtime.loop import now
+from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 
 CANDIDATE_LEASE = 3.0  # candidacy expires if not re-polled (s)
@@ -225,6 +226,8 @@ class CoordinatorServer:
             )
 
     async def candidacy(self, req: CandidacyRequest) -> LeaderReply:
+        if buggify():
+            await delay(0.01)  # slow nomination (election churn)
         st = self._leader(req.key)
         st.candidates[req.candidate.address] = (
             req.candidate,
@@ -311,6 +314,8 @@ class CoordinatedState:
         self._read_done = False
 
     async def read(self) -> Any:
+        if buggify():
+            await delay(0.005)  # slow coordinated-state read (recovery race)
         # phase 0: discover the highest generation out there
         polls = await _quorum_request(
             self.process, self.coordinators, Tokens.GEN_POLL, GenPollRequest(self.key)
@@ -336,6 +341,8 @@ class CoordinatedState:
 
     async def write(self, value: Any) -> None:
         assert self._read_done, "CoordinatedState.write before read"
+        if buggify():
+            await delay(0.005)  # widen the read→write fencing window
         writes = await _quorum_request(
             self.process,
             self.coordinators,

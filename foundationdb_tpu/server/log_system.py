@@ -29,6 +29,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..net.sim import BrokenPromise, Endpoint
+from ..runtime.buggify import buggify
 from ..runtime.futures import (
     AsyncVar,
     Future,
@@ -124,6 +125,10 @@ class LogSystem:
     ) -> None:
         """Push one commit batch; resolves when durable on every tlog
         (the push quorum — all replicas of every tag, see module doc)."""
+        if buggify():
+            from ..runtime.futures import delay
+
+            await delay(0.001)  # slow log fan-out (stretches the pipeline)
         from .interfaces import TLogCommitRequest
 
         from .systemdata import TXS_TAG
@@ -232,6 +237,9 @@ class PeekCursor:
                 await wait_for_any([self.config_var.on_change(), delay(0.5)])
                 continue
             log = replicas[self._replica % len(replicas)]
+            if buggify():
+                self._replica += 1  # rotate replica mid-stream (failover path)
+                log = replicas[self._replica % len(replicas)]
             req = TLogPeekRequest(tag=self.tag, begin=begin + 1)
             fut = self.process.request(log.ep("peek"), req)
             # a peek may long-poll forever at a tlog of a generation that

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from ..kv.keyrange_map import KeyRangeMap
 from ..runtime.futures import delay, wait_for_all
 from ..runtime.loop import now
+from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .coordination import ClusterStateChanged, CoordinatedState
 from .interfaces import (
@@ -79,6 +80,8 @@ class Master:
     async def get_commit_version(
         self, req: GetCommitVersionRequest
     ) -> GetCommitVersionReply:
+        if buggify():
+            await delay(0.001)  # slow version assignment (phase-1 stall)
         prev = self.last_assigned
         t = now()
         advance = int((t - self.last_assigned_at) * VERSIONS_PER_SECOND)

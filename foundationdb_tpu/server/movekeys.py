@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from ..net.sim import Endpoint
 from ..runtime.futures import delay
+from ..runtime.buggify import buggify
 from .interfaces import GetKeyServersRequest, Tokens
 from .systemdata import (
     MOVE_KEYS_LOCK_KEY,
@@ -80,6 +81,8 @@ async def move_shard(
     conflict and abort instead of interleaving start/finish writes
     (the reference's moveKeysLock + in-transaction reads,
     MoveKeys.actor.cpp startMoveKeys/finishMoveKeys)."""
+    if buggify():
+        poll_interval = 0.02  # aggressive polling races fetch completion
     reply = await db._proxy_request(
         Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=begin)
     )

@@ -89,6 +89,7 @@ from .interfaces import (
 )
 from .log_system import LogSystem
 from .tlog import TLogStopped
+from ..runtime.buggify import buggify
 
 
 class ShardMap:
@@ -198,6 +199,8 @@ class Proxy:
         # share one getLiveCommitted fetch (transactionStarter batching,
         # MasterProxyServer.actor.cpp:925); arrivals during a flight form
         # the next batch (RequestBatcher's causality rule).
+        if buggify():
+            await delay(0.001)  # slow GRV (client sees stale-ish versions)
         if self._grv_batcher is None:
             self._grv_batcher = RequestBatcher(
                 self._fetch_live_version, self.process.spawn
@@ -239,6 +242,8 @@ class Proxy:
 
     async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
         self._check_alive()
+        if buggify():
+            await delay(0.001)  # slow key-location lookup
         if getattr(req, "before", False):
             begin, end, team, tags = self.shards.team_before_key(req.key)
         else:
@@ -251,6 +256,8 @@ class Proxy:
 
     async def commit(self, req: CommitRequest) -> CommitReply:
         self._check_alive()
+        if buggify():
+            await delay(0.002)  # late-arriving commit (misses its batch)
         done: Future = Future()
         self._batch.append((req.transaction, done))
         if len(self._batch) == 1:
@@ -276,7 +283,9 @@ class Proxy:
                     continue
             # batch window: flush on interval or on the size trigger (which
             # may already have fired while we were parked on _work)
-            if len(self._batch) < self.knobs.MAX_BATCH_TXNS:
+            if buggify():
+                pass  # cut the batch immediately: tiny one-txn batches
+            elif len(self._batch) < self.knobs.MAX_BATCH_TXNS:
                 trigger = self._batch_trigger = Future()
                 await wait_for_any([trigger, delay(self.knobs.COMMIT_BATCH_INTERVAL)])
             batch, self._batch = self._batch, []

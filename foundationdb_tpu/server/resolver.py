@@ -20,8 +20,9 @@ The conflict check itself is the pluggable ConflictSet seam
 from __future__ import annotations
 
 from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
-from ..runtime.futures import VersionGate
+from ..runtime.futures import VersionGate, delay
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
 
 
@@ -36,7 +37,20 @@ class Resolver:
     ):
         self.knobs = knobs or Knobs()
         self.cs = new_conflict_set(backend, **backend_kw)
+        if first_version:
+            # a post-recovery resolver starts with empty history at the
+            # recovery version: snapshots older than it must be TOO_OLD
+            # (the reference recreates its ConflictSet via
+            # clearConflictSet at recovery, SkipList.cpp:1097)
+            self.cs.clear(first_version)
         self.gate = VersionGate(first_version)
+        # backends with an async dispatch path (the TPU kernel) pipeline:
+        # batch N+1 is dispatched to the device while N's verdicts are in
+        # flight — the device threads the history state, so dispatch order
+        # alone fixes the outcome. Post-collect bookkeeping (reply cache,
+        # state-txn echoes) still runs in version order via reply_gate.
+        self._pipelined = hasattr(self.cs, "detect_many_encoded_async")
+        self.reply_gate = VersionGate(first_version)
         self.uid = uid
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
@@ -57,6 +71,16 @@ class Resolver:
         if req.version in self._replies:  # resolved while waiting (dup)
             return self._replies[req.version]
         if req.prev_version < self.gate.version:
+            if (
+                self._pipelined
+                and req.version <= self.gate.version
+                and req.version > self.reply_gate.version
+            ):
+                # retransmit of a batch whose original is still in flight
+                # on the device: wait for its reply to materialize
+                await self.reply_gate.wait_until(req.version)
+                if req.version in self._replies:
+                    return self._replies[req.version]
             # stale retransmit of an already-superseded batch with no cached
             # reply: everything in it lost (proxy will have failed anyway)
             return ResolveBatchReply(
@@ -71,10 +95,29 @@ class Resolver:
             )
             for t in req.transactions
         ]
+        if buggify():
+            await delay(0.001)  # slow resolver (pipeline under jitter)
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
-        verdicts = self.cs.detect_batch(
-            txns, now=req.version, new_oldest_version=max(0, req.version - window)
-        )
+        oldest = max(0, req.version - window)
+        if self._pipelined:
+            self.cs.prepare(req.version)  # version-base rebase window
+            enc = self.cs.encode(txns)
+            handle = self.cs.detect_many_encoded_async(
+                [(enc, req.version, oldest)]
+            )
+            # the device now owns the (prev → version) ordering for this
+            # batch: open the gate and yield so the next batch in the
+            # chain dispatches before we block on this one's verdicts
+            # (the phase overlap of MasterProxyServer.actor.cpp:353,
+            # applied at the resolver↔device boundary)
+            self.gate.advance_to(req.version)
+            await delay(0)
+            verdicts = handle()[0]
+            await self.reply_gate.wait_until(req.prev_version)
+        else:
+            verdicts = self.cs.detect_batch(
+                txns, now=req.version, new_oldest_version=oldest
+            )
 
         if req.state_txn_indices:
             self._state_txns[req.version] = [
@@ -105,7 +148,10 @@ class Resolver:
             for v in [v for v in self._state_txns if v < horizon]:
                 del self._state_txns[v]
 
-        self.gate.advance_to(req.version)
+        if self._pipelined:
+            self.reply_gate.advance_to(req.version)
+        else:
+            self.gate.advance_to(req.version)
         return reply
 
     def register(self, process) -> None:

@@ -25,6 +25,7 @@ from ..kv.mutations import Mutation, MutationType
 from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
     GetKeyValuesReply,
@@ -103,6 +104,8 @@ class StorageServer:
         while True:
             self._maybe_rollback()
             messages, end = await self._cursor.next(self.version.get())
+            if buggify():
+                await delay(0.002)  # lagging storage (FutureVersion paths)
             self._maybe_rollback()  # config may have flipped while parked
             for version, mutations in messages:
                 if version <= self.version.get():
@@ -338,7 +341,7 @@ class StorageServer:
                 begin=lo,
                 end=end if end is not None else b"\xff\xff\xff\xff\xff",
                 version=at_version,
-                limit=self.knobs.STORAGE_FETCH_KEYS_BATCH,
+                limit=2 if buggify() else self.knobs.STORAGE_FETCH_KEYS_BATCH,
             )
             src = sources[src_i % len(sources)]
             from ..net.sim import Endpoint
@@ -408,7 +411,9 @@ class StorageServer:
 
     async def durability_loop(self):
         while True:
-            await delay(self.knobs.STORAGE_DURABILITY_LAG)
+            await delay(
+                0.02 if buggify() else self.knobs.STORAGE_DURABILITY_LAG
+            )  # eager durability: shrink the in-memory MVCC window
             new_durable = max(
                 0,
                 self.version.get() - self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS,
@@ -560,6 +565,8 @@ class StorageServer:
                 raise WrongShardServer()
 
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
+        if buggify():
+            await delay(0.001)  # slow replica (hedging/load-balance paths)
         await self._wait_for_version(req.version)
         self._check_read(req.key, req.key + b"\x00", req.version)
         known, value = self.data.get_with_presence(req.key, req.version)
@@ -570,11 +577,13 @@ class StorageServer:
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         await self._wait_for_version(req.version)
         self._check_read(req.begin, req.end, req.version)
+        # tiny replies force every caller through its `more`/windowing path
+        limit = 1 if buggify() else req.limit
         data = self._read_range_merged(
-            req.begin, req.end, req.version, req.limit + 1, req.reverse
+            req.begin, req.end, req.version, limit + 1, req.reverse
         )
-        more = len(data) > req.limit
-        return GetKeyValuesReply(data=data[: req.limit], more=more)
+        more = len(data) > limit
+        return GetKeyValuesReply(data=data[:limit], more=more)
 
     def _read_range_merged(self, begin, end, version, limit, reverse):
         """Window-over-engine merge (the reference's readRange:916 merge of
@@ -642,6 +651,8 @@ class StorageServer:
         (watchValue_impl:758). Fires on the version that changed it. The
         shard moving away surfaces as wrong_shard_server and the client
         re-registers at the new team."""
+        if buggify():
+            await delay(0.002)  # watch registration races a change
         await self._wait_for_version(req.version)
         while True:
             self._check_read(req.key, req.key + b"\x00", self.version.get())

@@ -24,6 +24,7 @@ import bisect
 from ..kv.diskqueue import DiskQueue
 from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from .systemdata import TXS_TAG
 from .interfaces import (
     TLogCommitRequest,
@@ -36,6 +37,29 @@ from .interfaces import (
 )
 
 FSYNC_TIME = 0.0005  # simulated DiskQueue sync
+
+
+class Spilled:
+    """In-memory placeholder for a spilled entry: the payload lives only
+    in the DiskQueue (spill-by-reference — the 6.3-style successor of the
+    reference's value spill, TLogServer.actor.cpp:518 updatePersistentData:
+    past TLOG_SPILL_THRESHOLD the tlog stops holding message payloads in
+    memory and serves peeks by reading the queue file). Keeps just the tag
+    set, which _trim and peek filtering need."""
+
+    __slots__ = ("tags",)
+
+    def __init__(self, tags):
+        self.tags = frozenset(tags)
+
+    def __contains__(self, tag):
+        return tag in self.tags
+
+    def __iter__(self):
+        return iter(self.tags)
+
+    def keys(self):
+        return self.tags
 
 
 class TLogStopped(Exception):
@@ -79,6 +103,11 @@ class TLog:
         # frontier (data loss at replication=1 after reboot).
         self._pop_busy = False
         self._pop_waiters: list[Future] = []
+        # spill accounting: in-memory payload bytes per version; past
+        # TLOG_SPILL_THRESHOLD the oldest durable entries' payloads are
+        # evicted (Spilled markers) and served back from the DiskQueue
+        self._entry_bytes: dict[Version, int] = {}
+        self._mem_bytes = 0
 
     async def recover(self) -> None:
         """Rebuild from the DiskQueue after a reboot
@@ -101,8 +130,11 @@ class TLog:
             if messages:
                 self._log.append((version, messages))
                 self._versions.append(version)
+                self._entry_bytes[version] = len(payload)
+                self._mem_bytes += len(payload)
             last = max(last, version)
         self.version.set(last)
+        self._maybe_spill()
         self._gate.advance_to(last)
         self.stopped = True
         self.locked_by_epoch = self.epoch
@@ -142,8 +174,14 @@ class TLog:
                 # while holding no payload for them
                 from ..runtime.serialize import write_tagged_messages
 
-                offset = self.dq.push(write_tagged_messages(req.version, msgs))
+                if buggify():
+                    await delay(0.002)  # slow disk: fsync under pressure
+                payload = write_tagged_messages(req.version, msgs)
+                offset = self.dq.push(payload)
                 self._dq_index.append((req.version, offset, self.dq._end))
+                if msgs:
+                    self._entry_bytes[req.version] = len(payload)
+                    self._mem_bytes += len(payload)
                 await self.dq.commit()
             else:
                 await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
@@ -168,6 +206,7 @@ class TLog:
             self.known_committed = req.known_committed
         if req.version > self.version.get():
             self.version.set(req.version)
+        self._maybe_spill()
         return None
 
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:
@@ -180,6 +219,53 @@ class TLog:
             end_version=self.version.get(), known_committed=self.known_committed
         )
 
+    def _maybe_spill(self) -> None:
+        """Evict the oldest durable entries' payloads once memory exceeds
+        TLOG_SPILL_THRESHOLD (updatePersistentData's trigger); peeks for
+        them read the DiskQueue (spill-by-reference). A tag that never
+        pops (a dead storage server) no longer grows tlog memory without
+        bound — only the queue file grows."""
+        if self.dq is None:
+            return
+        threshold = self.knobs.TLOG_SPILL_THRESHOLD
+        if buggify():
+            threshold = 64  # spill almost everything (exercise read-back)
+        if self._mem_bytes <= threshold:
+            return
+        target = threshold // 2
+        durable = self.version.get()
+        for idx, (v, msgs) in enumerate(self._log):
+            if self._mem_bytes <= target:
+                break
+            if isinstance(msgs, Spilled) or v > durable:
+                continue
+            self._log[idx] = (v, Spilled(msgs.keys()))
+            self._mem_bytes -= self._entry_bytes.pop(v, 0)
+
+    async def _read_spilled(self, version: Version):
+        """Fetch a spilled entry's messages from the DiskQueue. Serialized
+        with pop/compact (offsets are rewritten by compaction)."""
+        while self._pop_busy:
+            w = Future()
+            self._pop_waiters.append(w)
+            await w
+        self._pop_busy = True
+        try:
+            vs = [v for v, _o, _e in self._dq_index]
+            j = bisect.bisect_left(vs, version)
+            if j >= len(self._dq_index) or self._dq_index[j][0] != version:
+                raise IOError(f"tlog {self.log_id}: spilled {version} not in dq")
+            _v, off, end = self._dq_index[j]
+            payload = await self.dq.read_entry(off, end)
+        finally:
+            self._pop_busy = False
+            if self._pop_waiters:
+                self._pop_waiters.pop(0)._set(None)
+        from ..runtime.serialize import read_tagged_messages
+
+        _ver, messages = read_tagged_messages(payload)
+        return messages
+
     async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
         # long-poll: wait until data through req.begin exists (a stopped
         # tlog's horizon is final — reply immediately with what it has)
@@ -191,9 +277,14 @@ class TLog:
         # must not be served (a peeker would double-apply them next poll)
         hi = bisect.bisect_right(self._versions, durable)
         out = []
-        for v, msgs in self._log[i:hi]:
+        for v, msgs in list(self._log[i:hi]):
             if req.tag in msgs:
-                out.append((v, msgs[req.tag]))
+                if isinstance(msgs, Spilled):
+                    full = await self._read_spilled(v)
+                    if req.tag in full:
+                        out.append((v, full[req.tag]))
+                else:
+                    out.append((v, msgs[req.tag]))
         return TLogPeekReply(messages=out, end_version=durable)
 
     async def pop(self, req: TLogPopRequest):
@@ -225,7 +316,7 @@ class TLog:
                         else:
                             self.dq.pop(self._dq_index[-1][2])
                         del self._dq_index[:j]
-                        self._pops_since_compact += 1
+                        self._pops_since_compact += 64 if buggify() else 1
                         # compact only with no commit in flight: compaction
                         # rewrites offsets and must not interleave with
                         # pushes
@@ -286,7 +377,14 @@ class TLog:
             if v > horizon:
                 new_log.append((v, msgs))
             elif TXS_TAG in msgs and v > txs_popped:
-                new_log.append((v, {TXS_TAG: msgs[TXS_TAG]}))
+                if isinstance(msgs, Spilled):
+                    new_log.append((v, Spilled({TXS_TAG})))
+                else:
+                    new_log.append((v, {TXS_TAG: msgs[TXS_TAG]}))
+                    # approximate: the retained txs sliver is small
+                    self._mem_bytes -= self._entry_bytes.pop(v, 0)
+            else:
+                self._mem_bytes -= self._entry_bytes.pop(v, 0)
         self._log = new_log
         self._versions = [v for v, _ in new_log]
         # the DiskQueue frontier must stop short of the first retained

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from ..net.sim import Endpoint
 from ..runtime.futures import AsyncVar, delay, timeout
 from ..runtime.knobs import Knobs
+from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .coordination import LeaderInfo, monitor_leader, try_become_leader
 from .interfaces import (
@@ -149,7 +150,9 @@ class Worker:
                     )
                 except Exception:
                     pass
-            await delay(self.knobs.HEARTBEAT_INTERVAL)
+            await delay(
+                self.knobs.HEARTBEAT_INTERVAL * (2 if buggify() else 1)
+            )  # missed heartbeats: flirt with the failure detector
 
     # -- CC candidacy ----------------------------------------------------------
 
@@ -240,6 +243,8 @@ class Worker:
     # -- recruitment (workerServer role dispatch :693-794) ----------------------
 
     async def recruit(self, req: RecruitRoleRequest) -> RecruitRoleReply:
+        if buggify():
+            await delay(0.01)  # slow recruitment (stretches recovery)
         if req.uid in self.roles:
             return RecruitRoleReply(address=self.process.address, uid=req.uid)
         maker = getattr(self, f"_make_{req.role}", None)

@@ -1,0 +1,116 @@
+"""Seeded chaos soak — the randomized-simulation driver.
+
+The analog of running the reference's `-r simulation` specs across seeds
+(SimulatedCluster.actor.cpp:886 setupSimulatedSystem picks a random
+cluster shape; knobs and BUGGIFY sites randomize per run; fault workloads
+run during correctness workloads; ConsistencyCheck runs after —
+tester.actor.cpp:740). A failing seed reproduces exactly.
+
+Run: python -m foundationdb_tpu.tools.soak [n_seeds] [first_seed]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..client.database import Database
+from ..net.sim import Sim
+from ..runtime.futures import spawn
+from ..runtime.knobs import Knobs
+from ..server.cluster import ClusterConfig, DynamicCluster
+from ..workloads import (
+    AttritionWorkload,
+    ConsistencyCheckWorkload,
+    CycleWorkload,
+    RandomCloggingWorkload,
+    SidebandWorkload,
+    run_workloads,
+)
+
+
+def random_config(rng) -> tuple[ClusterConfig, int, int]:
+    """A random legal cluster shape (setupSimulatedSystem:886)."""
+    replication = rng.random_choice([1, 2])
+    n_teams = rng.random_choice([1, 2, 3])
+    cfg = ClusterConfig(
+        n_proxies=rng.random_choice([1, 2]),
+        n_resolvers=rng.random_choice([1, 2]),
+        n_tlogs=rng.random_choice([1, 2, 3]),
+        tlog_replication=1 if rng.coinflip(0.5) else min(2, 2),
+        n_storage=replication * n_teams,
+        replication=replication,
+        conflict_backend=rng.random_choice(["oracle", "oracle", "tpu"]),
+    )
+    if cfg.tlog_replication > cfg.n_tlogs:
+        cfg.tlog_replication = cfg.n_tlogs
+    n_coordinators = rng.random_choice([1, 3])
+    n_zones = rng.random_choice([0, 3])
+    return cfg, n_coordinators, n_zones
+
+
+def run_one(seed: int, verbose: bool = False) -> dict:
+    """One randomized chaos run; raises on any check failure."""
+    knobs = Knobs()
+    sim = Sim(seed=seed, knobs=knobs, chaos=True)
+    sim.activate()
+    shape_rng = sim.loop.random.fork()
+    knobs.randomize(shape_rng)
+    cfg, n_coordinators, n_zones = random_config(shape_rng)
+    cluster = DynamicCluster(
+        sim, cfg, n_coordinators=n_coordinators, n_zones=n_zones
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    rng = sim.loop.random
+
+    kills = int(shape_rng.random_choice([0, 1, 2]))
+    workloads = [
+        CycleWorkload(db, rng.fork(), nodes=10, transactions=25),
+        SidebandWorkload(db, rng.fork(), messages=25),
+        RandomCloggingWorkload(db, rng.fork(), duration=4.0),
+    ]
+    if kills and cfg.replication > 1:
+        workloads.append(
+            AttritionWorkload(
+                db,
+                rng.fork(),
+                sim=sim,
+                kills=kills,
+                interval=4.0,
+                protect=set(cluster.coordinators),
+            )
+        )
+    workloads.append(
+        ConsistencyCheckWorkload(db, rng.fork(), replication=cfg.replication)
+    )
+
+    sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
+    fired = len(sim.buggify.fired)
+    if verbose:
+        print(
+            f"seed {seed}: shape p{cfg.n_proxies} r{cfg.n_resolvers} "
+            f"t{cfg.n_tlogs} s{cfg.n_storage}x{cfg.replication} "
+            f"zones={n_zones} coords={n_coordinators} kills={kills} "
+            f"backend={cfg.conflict_backend} buggify_fired={fired}"
+        )
+    return {"seed": seed, "buggify_fired": fired, "config": cfg.as_dict()}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    n = int(argv[0]) if argv else 20
+    first = int(argv[1]) if len(argv) > 1 else 0
+    failures = []
+    for seed in range(first, first + n):
+        try:
+            run_one(seed, verbose=True)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            failures.append((seed, repr(e)))
+            print(f"seed {seed}: FAILED {e!r}")
+    print(f"{n - len(failures)}/{n} seeds green")
+    for seed, err in failures:
+        print(f"  repro: seed={seed} {err}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

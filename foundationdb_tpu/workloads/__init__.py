@@ -46,3 +46,5 @@ from .conflict_range import ConflictRangeWorkload  # noqa: E402,F401
 from .sideband import SidebandWorkload  # noqa: E402,F401
 from .write_during_read import WriteDuringReadWorkload  # noqa: E402,F401
 from .clogging import RandomCloggingWorkload  # noqa: E402,F401
+from .attrition import AttritionWorkload  # noqa: E402,F401
+from .consistency_check import ConsistencyCheckWorkload  # noqa: E402,F401

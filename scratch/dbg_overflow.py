@@ -1,0 +1,88 @@
+import faulthandler
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+faulthandler.dump_traceback_later(150, exit=True)
+
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax._src.xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+
+import random
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict import sharded
+from foundationdb_tpu.conflict.api import Verdict
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+
+sys.path.insert(0, "/root/repo/tests")
+import test_sharded_grid as tg
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-t0:7.1f}] {msg}", flush=True)
+
+
+n_part, n_data = 2, 1
+mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), axis_names=("part", "data"))
+L, width = 2, 8
+B, S = 4, 8
+T, KR, KW = 16, 1, 1
+rnd = random.Random(13)
+
+states = sharded.make_sharded_states(n_part, B, S, L)
+spec = jax.tree.map(lambda _: NamedSharding(mesh, P("part")), G.GridState(0, 0, 0, 0))
+states = jax.device_put(states, spec)
+step = sharded.build_sharded_resolver(mesh, lanes=L)
+grown = (B, S)
+log("setup done")
+
+oracle = OracleConflictSet()
+for i in range(5):
+    txs = tg._make_txns(rnd, T, 120, i, span=2)
+    want = oracle.detect_batch(list(txs), i + 20, max(i - 4, 0))
+    batch = tg._encode_batch(txs, width, T, KR, KW)
+    snapshot = jax.tree.map(lambda x: x + 0, states)
+    tries = 0
+    while True:
+        tries += 1
+        Bc, Sc = grown
+        log(f"batch {i} try {tries} Bc={Bc}")
+        new_states, verdicts, pressure = step(
+            states, batch, np.int32(i + 20), np.int32(max(i - 4, 0)), np.int32(max(i - 4, 0))
+        )
+        pr = np.asarray(pressure)
+        log(f"  pressure {pr.tolist()}")
+        if (pr[:, 0] <= G.staging_slots(Sc)).all() and (pr[:, 1] <= Sc).all():
+            states = new_states
+            break
+        Bc *= 2
+        log("  device_get snapshot")
+        host_snap = jax.tree.map(jax.device_get, snapshot)
+        parts = []
+        for p in range(n_part):
+            shard = jax.tree.map(lambda x: x[p], host_snap)
+            log(f"  reshard part {p} -> B={Bc}")
+            new_shard, pres = G.reshard_device(shard, Bc, Sc)
+            log(f"  reshard part {p} done pres={int(jax.device_get(pres))}")
+            parts.append(jax.tree.map(np.asarray, new_shard))
+        log("  stacking")
+        states = jax.device_put(jax.tree.map(lambda *xs: np.stack(xs), *parts), spec)
+        log("  device_put done")
+        snapshot = jax.tree.map(lambda x: x + 0, states)
+        grown = (Bc, Sc)
+    got = [Verdict(int(v)) for v in np.asarray(verdicts)[: len(txs)]]
+    assert got == want, f"batch {i}"
+    log(f"batch {i} OK")
+log(f"done, grown={grown}")

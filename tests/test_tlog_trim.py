@@ -78,3 +78,53 @@ def test_trim_all_popped_only_txs_left():
         assert [v for v, _m in reply.messages] == [1]
 
     run(body())
+
+
+def test_spill_bounds_memory_and_serves_peeks():
+    """TLOG_SPILL_THRESHOLD: a tag that never pops (dead storage server)
+    must not grow tlog memory without bound — old payloads spill to the
+    DiskQueue (spill-by-reference, TLogServer.actor.cpp:518) and peeks
+    read them back transparently."""
+    from foundationdb_tpu.kv.mutations import Mutation, MutationType
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.server.tlog import Spilled
+
+    sim = Sim(seed=9)
+    sim.activate()
+
+    async def body():
+        knobs = Knobs()
+        knobs.TLOG_SPILL_THRESHOLD = 2048
+        tl = TLog(log_id="ts", disk=sim.disk("m0"), knobs=knobs)
+        prev = 0
+        payload = [Mutation(MutationType.SET_VALUE, b"k" * 32, b"v" * 32)]
+        for v in range(1, 101):
+            await tl.commit(
+                TLogCommitRequest(
+                    epoch=0, prev_version=prev, version=v,
+                    messages={0: list(payload), 1: list(payload)},
+                    known_committed=0,
+                )
+            )
+            prev = v
+        assert tl._mem_bytes <= 2048, tl._mem_bytes
+        assert any(isinstance(m, Spilled) for _v, m in tl._log)
+
+        # a late peek from version 1 reads spilled payloads back intact
+        reply = await tl.peek(TLogPeekRequest(tag=1, begin=1))
+        assert [v for v, _m in reply.messages] == list(range(1, 101))
+        assert all(m == payload for _v, m in reply.messages)
+
+        # popping tag 0 must not disturb tag 1's spilled data
+        await tl.pop(TLogPopRequest(tag=0, upto=50))
+        reply = await tl.peek(TLogPeekRequest(tag=1, begin=1))
+        assert [v for v, _m in reply.messages] == list(range(1, 101))
+
+        # after every tag pops, memory and log drain
+        await tl.pop(TLogPopRequest(tag=1, upto=100))
+        await tl.pop(TLogPopRequest(tag=0, upto=100))
+        assert tl._versions == []
+        assert tl._mem_bytes == 0, tl._mem_bytes
+        return True
+
+    assert sim.run_until_done(spawn(body()), 60.0)

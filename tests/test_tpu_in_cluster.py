@@ -1,0 +1,143 @@
+"""The TPU conflict backend running INSIDE the database (CPU twin under
+sim): resolvers built with conflict_backend="tpu" resolve real commit
+batches through the proxy pipeline, pipelined via the encoded/async path,
+with verdict behavior identical to the oracle-backed cluster — including
+across a recovery (fresh ConflictSet at the recovery version)."""
+
+import pytest
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.errors import NotCommitted
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn, wait_for_all
+from foundationdb_tpu.server import Cluster, ClusterConfig
+from foundationdb_tpu.server.cluster import DynamicCluster
+
+
+def make_db(seed=0, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(conflict_backend="tpu", **cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def drive(sim, coro, limit=300.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+def test_tpu_backend_resolves_commits():
+    sim, cluster, db = make_db(seed=31)
+
+    async def go():
+        tr = db.transaction()
+        tr.set(b"a", b"1")
+        await tr.commit()
+
+        # read-write conflict: t1 reads a, t2 writes a, t2 commits first
+        t1 = db.transaction()
+        await t1.get(b"a")
+        t1.set(b"b", b"from-t1")
+        t2 = db.transaction()
+        t2.set(b"a", b"2")
+        await t2.commit()
+        with pytest.raises(NotCommitted):
+            await t1.commit()
+
+        # blind writes never conflict
+        t3 = db.transaction()
+        t3.set(b"a", b"3")
+        await t3.commit()
+
+        tr = db.transaction()
+        assert await tr.get(b"a") == b"3"
+        assert await tr.get(b"b") is None
+        return True
+
+    assert drive(sim, go())
+
+
+def test_tpu_backend_concurrent_contention():
+    """Many concurrent increment transactions on few keys: exactly the
+    committed ones apply (lost-update safety end-to-end through the
+    pipelined TPU resolver)."""
+    sim, cluster, db = make_db(seed=32, n_proxies=2, n_resolvers=2)
+
+    async def go():
+        init = db.transaction()
+        for k in (b"x", b"y"):
+            init.set(k, b"0")
+        await init.commit()
+
+        async def incr(key):
+            for _ in range(30):
+                tr = db.transaction()
+                try:
+                    v = int(await tr.get(key))
+                    tr.set(key, b"%d" % (v + 1))
+                    await tr.commit()
+                    return True
+                except Exception as e:
+                    await tr.on_error(e)
+            return False
+
+        oks = await wait_for_all(
+            [spawn(incr(b"x")) for _ in range(8)]
+            + [spawn(incr(b"y")) for _ in range(8)]
+        )
+        assert all(oks)
+        tr = db.transaction()
+        assert await tr.get(b"x") == b"8"
+        assert await tr.get(b"y") == b"8"
+        return True
+
+    assert drive(sim, go())
+
+
+def test_tpu_backend_survives_recovery():
+    """Kill the master mid-run with TPU-backed resolvers: the new epoch's
+    resolvers start a fresh device index at the recovery version; old
+    snapshots turn TOO_OLD and retries converge."""
+    sim = Sim(seed=33)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(n_storage=2, n_resolvers=2, conflict_backend="tpu"),
+        n_coordinators=3,
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def go():
+        async def fill(tr):
+            for i in range(10):
+                tr.set(b"r%02d" % i, b"v%d" % i)
+
+        await db.run(fill)
+
+        master_addr = None
+        for addr, p in sim.processes.items():
+            w = getattr(p, "worker", None)
+            if w is not None and p.alive and any(
+                h.kind == "master" for h in w.roles.values()
+            ):
+                master_addr = addr
+        assert master_addr
+        sim.kill_process(master_addr)
+
+        async def more(tr):
+            tr.set(b"post-recovery", b"ok")
+
+        await db.run(more)
+
+        db2 = Database.from_coordinators(sim, cluster.coordinators, client_addr="c2")
+
+        async def check(tr):
+            vals = [await tr.get(b"r%02d" % i) for i in range(10)]
+            vals.append(await tr.get(b"post-recovery"))
+            return vals
+
+        vals = await db2.run(check)
+        assert vals == [b"v%d" % i for i in range(10)] + [b"ok"]
+        return True
+
+    assert drive(sim, go(), limit=600.0)
