@@ -248,7 +248,11 @@ class TpuConflictSet(ConflictSet):
 
     def _encode(self, transactions) -> G.Batch:
         n = max(len(transactions), 1)
-        T = _bucket(n, 8)
+        # pad T to a coarse grid: powers of two up to 512, then multiples
+        # of 512 — a 2500-txn batch costs 2560 rows of work, not 4096
+        # (every kernel phase scales with T; the compile cache still only
+        # sees a handful of shapes)
+        T = _bucket(n, 8) if n <= 512 else ((n + 511) // 512) * 512
         KR = _bucket(
             max((len(t.read_conflict_ranges) for t in transactions), default=0)
             or 1

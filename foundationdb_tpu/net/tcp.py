@@ -130,10 +130,13 @@ class RealNode:
     """The local process — SimProcess-compatible surface."""
 
     def __init__(self, world: "RealWorld", address: str):
+        from ..runtime.locality import Locality
+
         self.world = world
         self.sim = world  # roles access knobs/disk/loop through .sim
         self.address = address
         self.machine = address
+        self.locality = Locality.of(address, zone=world.zone, dc=world.dc)
         self.endpoints: dict[str, Callable] = {}
         self.actors = ActorCollection()
         self.alive = True
@@ -161,10 +164,14 @@ class RealWorld:
         data_dir: Optional[str] = None,
         loop: Optional[RealLoop] = None,
         seed: Optional[int] = None,
+        zone: Optional[str] = None,
+        dc: str = "dc0",
     ):
         self.loop = loop or RealLoop(seed)
         self.knobs = knobs or Knobs()
         self.data_dir = data_dir
+        self.zone = zone
+        self.dc = dc
         self.node = RealNode(self, listen_addr)
         # Sim-surface compatibility (Database, roles):
         self.processes = {listen_addr: self.node}
@@ -173,6 +180,7 @@ class RealWorld:
         self._connecting: dict[str, Future] = {}
         self._anon: list[_Conn] = []  # accepted, pre-handshake
         self._pending: dict[int, tuple[Future, str]] = {}  # id → (fut, peer)
+        self._disconnect_watchers: list[Callable[[str], None]] = []
         self._next_id = 1
         self._listener: Optional[socket.socket] = None
         self._listen()
@@ -225,6 +233,14 @@ class RealWorld:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((host, int(port)))
+        if int(port) == 0:
+            # ephemeral port (clients like fdbcli): adopt the real one as
+            # this node's identity before anything handshakes with it
+            real = s.getsockname()[1]
+            addr = f"{host}:{real}"
+            self.processes[addr] = self.processes.pop(self.node.address)
+            self.node.address = addr
+            self.node.machine = addr
         s.listen(128)
         s.setblocking(False)
         self._listener = s
@@ -278,6 +294,19 @@ class RealWorld:
         waiter = self._connecting.pop(conn.peer, None) if conn.peer else None
         if waiter is not None and not waiter.is_ready():
             waiter._set_error(BrokenPromise(f"connect to {conn.peer} failed"))
+        # failure-monitor hook (the reference wires connection failure into
+        # SimpleFailureMonitor, FlowTransport.actor.cpp): subscribers learn
+        # about a dead peer immediately instead of waiting out heartbeats
+        if conn.peer is not None:
+            for cb in list(self._disconnect_watchers):
+                try:
+                    cb(conn.peer)
+                except Exception:
+                    pass
+
+    def on_peer_disconnect(self, cb: Callable[[str], None]) -> None:
+        """Register a connection-failure callback (peer listen address)."""
+        self._disconnect_watchers.append(cb)
 
     def _connect(self, peer: str) -> Future:
         """Future resolving when a connection to ``peer`` is live."""
@@ -303,6 +332,12 @@ class RealWorld:
             return waiter
 
         conn = _Conn(self, sock, peer)
+        # queue our preamble NOW: on localhost the peer's preamble can
+        # arrive (and resolve the connect waiter) before the writability
+        # callback below ever runs — a request sent at that moment must
+        # find the handshake already ahead of it in the buffer, or the
+        # first frame beats the preamble onto the wire
+        conn.outbuf += wire.handshake_bytes(self.node.address)
 
         def on_connected():
             if conn.closed:
@@ -313,7 +348,6 @@ class RealWorld:
                 conn.close()
                 return
             try:
-                conn.outbuf += wire.handshake_bytes(self.node.address)
                 conn._on_writable()
                 if conn.outbuf:
                     self.loop.add_writer(sock, conn._on_writable)

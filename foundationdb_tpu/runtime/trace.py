@@ -38,6 +38,7 @@ class TraceLog:
             self.error_count += 1
         if self._file:
             self._file.write(json.dumps(ev, default=str) + "\n")
+            self._file.flush()
 
     def of_type(self, event_type: str) -> list[dict]:
         return [e for e in self.events if e["Type"] == event_type]

@@ -171,3 +171,59 @@ class FdbCli:
             self.db, self.coordinators, self.db.client, **changes
         )
         return "Configuration changed; recovery triggered"
+
+
+def main(argv=None) -> int:
+    """fdbcli over real TCP: connect to a running cluster's coordinators.
+
+      python -m foundationdb_tpu.tools.cli -C 127.0.0.1:4500 --exec "set k v"
+
+    Without --exec, reads commands from stdin (one per line)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(prog="fdbcli")
+    ap.add_argument("-C", "--cluster", required=True, help="coordinator list")
+    ap.add_argument("--exec", dest="cmds", action="append", default=[])
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    from ..client.database import Database
+    from ..net.tcp import RealWorld
+    from ..runtime.futures import spawn
+
+    coordinators = [c for c in args.cluster.split(",") if c]
+    world = RealWorld("127.0.0.1:0")
+    world.activate()
+    db = Database.from_coordinators(world, coordinators)
+    cli = FdbCli(db, coordinators)
+
+    def run_one(line: str) -> int:
+        try:
+            out = world.run_until_done(spawn(cli.execute(line)), args.timeout)
+        except TimeoutError:
+            print("ERROR: timed out", flush=True)
+            return 1
+        print(out, flush=True)
+        return 1 if out.startswith("ERROR") else 0
+
+    rc = 0
+    try:
+        if args.cmds:
+            for line in args.cmds:
+                rc |= run_one(line)
+        else:
+            for line in sys.stdin:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line in ("exit", "quit"):
+                    break
+                rc |= run_one(line)
+    finally:
+        world.close()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

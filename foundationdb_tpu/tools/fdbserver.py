@@ -1,0 +1,118 @@
+"""fdbserver — one real OS process of the cluster, over TCP.
+
+The analog of fdbserver/fdbserver.actor.cpp main (role flag parsing
+:956-971) + fdbd (worker.actor.cpp:962): boots either a coordinator
+(generation + leader registers) or a worker (registers with the elected
+cluster controller, hosts whatever roles get recruited) on the real TCP
+transport (net/tcp.py). Every role runs unmodified — the Sim-compatible
+surface of RealWorld is the whole porting layer.
+
+  python -m foundationdb_tpu.tools.fdbserver \\
+      --listen 127.0.0.1:4500 --role coordinator --datadir /tmp/c0
+  python -m foundationdb_tpu.tools.fdbserver \\
+      --listen 127.0.0.1:4600 --role worker --class storage \\
+      --coordinators 127.0.0.1:4500 --config n_storage=2,replication=1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_config(text: str) -> dict:
+    out: dict = {}
+    if not text:
+        return out
+    for part in text.split(","):
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v) if v.strip().isdigit() else v.strip()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fdbserver")
+    ap.add_argument("--listen", required=True, help="host:port to bind")
+    ap.add_argument(
+        "--role", choices=["coordinator", "worker"], default="worker"
+    )
+    ap.add_argument("--coordinators", default="", help="comma-separated")
+    ap.add_argument(
+        "--class",
+        dest="process_class",
+        default="unset",
+        choices=["storage", "transaction", "stateless", "unset"],
+    )
+    ap.add_argument("--config", default="", help="k=v,... cluster shape")
+    ap.add_argument("--datadir", default=None)
+    ap.add_argument("--zone", default=None)
+    ap.add_argument("--dc", default="dc0")
+    ap.add_argument("--tracefile", default=None, help="JSONL trace output")
+    ap.add_argument(
+        "--knob",
+        action="append",
+        default=[],
+        help="NAME=value (repeatable; the --knob_name flag path)",
+    )
+    args = ap.parse_args(argv)
+
+    from ..net.tcp import RealWorld
+    from ..runtime.knobs import Knobs
+
+    if args.tracefile:
+        from ..runtime.trace import TraceLog, set_trace_log
+
+        set_trace_log(TraceLog(args.tracefile))
+
+    knob_overrides = {}
+    for kv in args.knob:
+        name, _, val = kv.partition("=")
+        try:
+            parsed: object = int(val)
+        except ValueError:
+            try:
+                parsed = float(val)
+            except ValueError:
+                parsed = val
+        knob_overrides[name.upper()] = parsed
+    knobs = Knobs(**knob_overrides)
+
+    world = RealWorld(
+        args.listen,
+        knobs=knobs,
+        data_dir=args.datadir,
+        zone=args.zone,
+        dc=args.dc,
+    )
+    world.activate()
+
+    if args.role == "coordinator":
+        from ..server.coordination import CoordinatorServer
+
+        CoordinatorServer().register(world.node)
+    else:
+        from ..server.worker import Worker
+
+        coordinators = [c for c in args.coordinators.split(",") if c]
+        if not coordinators:
+            ap.error("--role worker requires --coordinators")
+        Worker(
+            world.node,
+            coordinators,
+            process_class=args.process_class,
+            initial_config=parse_config(args.config),
+            knobs=knobs,
+        ).start()
+
+    print(f"fdbserver: {args.role} listening on {args.listen}", flush=True)
+    try:
+        world.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        world.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

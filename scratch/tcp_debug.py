@@ -1,0 +1,44 @@
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/tests")
+import test_tcp_cluster as T
+
+ports = T.free_ports(5)
+cport, *wports = ports
+coord = f"127.0.0.1:{cport}"
+procs = [
+    T.spawn_server(["--listen", coord, "--role", "coordinator",
+                    "--datadir", f"/tmp/tcpdbg/coord", "--tracefile", "/tmp/tcpdbg/coord.trace"])
+]
+config = "n_storage=2,replication=1,n_tlogs=1"
+classes = ["storage", "storage", "transaction", "stateless"]
+for port, pclass in zip(wports, classes):
+    procs.append(
+        T.spawn_server([
+            "--listen", f"127.0.0.1:{port}", "--role", "worker",
+            "--class", pclass, "--coordinators", coord,
+            "--config", config, "--datadir", f"/tmp/tcpdbg/w{port}", "--tracefile", f"/tmp/tcpdbg/w{port}.trace",
+        ])
+    )
+time.sleep(10)
+for p in procs:
+    if p.poll() is not None:
+        print("EXITED:", p.args)
+rc, out = T.fdbcli(coord, "set hello world", timeout=30)
+print("cli rc", rc, "out", out)
+for p in procs:
+    p.kill()
+outs = []
+for p in procs:
+    try:
+        o, _ = p.communicate(timeout=5)
+    except Exception:
+        o = "<none>"
+    outs.append(o)
+for p, o in zip(procs, outs):
+    print("=== ", " ".join(p.args[-6:]))
+    print(o[-1500:])
