@@ -25,7 +25,7 @@ from ..runtime.locality import Locality
 from ..runtime.buggify import buggify
 from ..runtime.knobs import Knobs
 from ..runtime.loop import EventLoop, TaskPriority, set_loop
-from ..runtime.trace import SevInfo, SevWarn, trace
+from ..runtime.trace import SevError, SevInfo, SevWarn, trace
 
 
 class BrokenPromise(Exception):
@@ -53,9 +53,28 @@ class SimProcess:
         self.locality = locality or Locality.of(machine)
         self.boot = boot  # async fn(process) rerun on reboot
         self.endpoints: dict[str, Callable] = {}  # token → async handler
-        self.actors = ActorCollection()
+        self.actors = ActorCollection(on_error=self._on_actor_error)
         self.alive = True
         self.reboots = 0
+
+    def _on_actor_error(self, err: BaseException) -> None:
+        """Unhandled actor death is LOUD: SevError with traceback (the
+        reference's unhandled-error → TraceEvent("...Error") + death path,
+        flow/ActorCollection.actor.cpp). BrokenPromise is routine in sim
+        (requests racing kills), and propagated Cancelled is its moral
+        equivalent (awaiting a cancelled sibling) — warn, don't scream."""
+        import traceback as _tb
+
+        sev = SevWarn if isinstance(err, (BrokenPromise, Cancelled)) else SevError
+        trace(
+            sev,
+            "UnhandledActorError",
+            self.address,
+            Err=repr(err),
+            Backtrace="".join(
+                _tb.format_exception(type(err), err, err.__traceback__)
+            )[-2000:],
+        )
 
     def register(self, token: str, handler: Callable) -> Endpoint:
         self.endpoints[token] = handler
@@ -234,7 +253,7 @@ class Sim:
         trace(SevInfo, "RebootProcess", address)
         p.alive = True
         p.reboots += 1
-        p.actors = ActorCollection()
+        p.actors = ActorCollection(on_error=p._on_actor_error)
         p.spawn(p.boot(p))
 
     # -- running --------------------------------------------------------------

@@ -31,7 +31,7 @@ from ..errors import FdbError
 from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
 from ..runtime.knobs import Knobs
 from ..runtime.loop import RealLoop, TaskPriority, set_loop
-from ..runtime.trace import SevInfo, SevWarn, trace
+from ..runtime.trace import SevError, SevInfo, SevWarn, trace
 from . import wire
 from .sim import BrokenPromise, Endpoint
 
@@ -138,8 +138,41 @@ class RealNode:
         self.machine = address
         self.locality = Locality.of(address, zone=world.zone, dc=world.dc)
         self.endpoints: dict[str, Callable] = {}
-        self.actors = ActorCollection()
+        self.actors = ActorCollection(on_error=self._on_actor_error)
         self.alive = True
+        # a real OS process always boots with a fresh memory image, so its
+        # in-memory reboot counter is 0; role code may read it either way
+        # (SimProcess counts sim reboots for change-id salting)
+        self.reboots = 0
+
+    def _on_actor_error(self, err: BaseException) -> None:
+        """Unhandled actor death: SevError + traceback, and — when this
+        process is a server (fdbserver sets die_on_actor_error) — process
+        exit, so supervision/tests see the crash instead of a silent hang
+        (the reference's criticalError path, flow/Error.cpp)."""
+        import sys
+        import traceback as _tb
+
+        tb = "".join(_tb.format_exception(type(err), err, err.__traceback__))
+        # BrokenPromise (requests racing deaths) and propagated Cancelled
+        # (awaiting a sibling being torn down) are routine — warn, no death
+        benign = isinstance(err, (BrokenPromise, Cancelled))
+        trace(
+            SevWarn if benign else SevError,
+            "UnhandledActorError",
+            self.address,
+            Err=repr(err),
+            Backtrace=tb[-2000:],
+        )
+        if self.world.die_on_actor_error and not benign:
+            print(
+                f"fatal: unhandled actor error on {self.address}:\n{tb}",
+                file=sys.stderr,
+                flush=True,
+            )
+            import os
+
+            os._exit(44)
 
     def register(self, token: str, handler: Callable) -> Endpoint:
         self.endpoints[token] = handler
@@ -166,9 +199,11 @@ class RealWorld:
         seed: Optional[int] = None,
         zone: Optional[str] = None,
         dc: str = "dc0",
+        die_on_actor_error: bool = False,
     ):
         self.loop = loop or RealLoop(seed)
         self.knobs = knobs or Knobs()
+        self.die_on_actor_error = die_on_actor_error
         self.data_dir = data_dir
         self.zone = zone
         self.dc = dc

@@ -295,10 +295,13 @@ def parse_handshake(buf: bytearray):
 
 
 def _seed_registry() -> None:
-    from ..server import interfaces, log_system, coordination
+    from ..server import interfaces, log_system, coordination, master
     from ..kv import mutations
+    from ..runtime import locality
 
-    for mod in (interfaces, log_system, coordination, mutations):
+    # every dataclass a role can hand to request()/CoordinatedState.write()
+    # must be here — DBCoreState travels to coordinators over real TCP
+    for mod in (interfaces, log_system, coordination, master, mutations, locality):
         register_module(mod)
 
     from ..kv.keyrange_map import KeyRangeMap

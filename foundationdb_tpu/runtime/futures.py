@@ -442,11 +442,17 @@ class VersionGate:
 
 class ActorCollection:
     """Holds actor futures; errors propagate, completions are discarded
-    (flow/ActorCollection.actor.cpp)."""
+    (flow/ActorCollection.actor.cpp).
 
-    def __init__(self):
+    ``on_error`` (optional) is invoked synchronously with the exception the
+    first time an actor dies unhandled — the hook that makes actor death
+    LOUD (the reference turns an unhandled error into a TraceEvent + process
+    death; silence here once hid a cluster-wide boot failure)."""
+
+    def __init__(self, on_error: Optional[Callable[[BaseException], None]] = None):
         self._actors: list[Future] = []
         self.error: Future = Future()
+        self.on_error = on_error
 
     def add(self, fut: Future) -> None:
         self._actors.append(fut)
@@ -462,6 +468,11 @@ class ActorCollection:
                 and f._task._cancelled
             )
             if f._error is not None and not genuine_cancel:
+                if self.on_error is not None:
+                    try:
+                        self.on_error(f._error)
+                    except Exception:
+                        pass
                 if not self.error.is_ready():
                     self.error._set_error(f._error)
             # prune: completed actors (and their results) must not accumulate

@@ -369,6 +369,15 @@ async def try_become_leader(
     us. The caller keeps the returned object alive."""
     from ..runtime.futures import spawn
 
+    trace(
+        SevInfo,
+        "CandidacyStarted",
+        process.address,
+        Key=key,
+        Priority=info.priority,
+        ChangeId=info.change_id,
+    )
+
     async def _settle(fut):
         """Swallow per-coordinator failures (a dead coordinator is a lost
         vote, not a lost election)."""
@@ -410,6 +419,14 @@ async def try_become_leader(
             if mine >= need:
                 for other in pending.values():
                     other.cancel()
+                trace(
+                    SevInfo,
+                    "ElectionWon",
+                    process.address,
+                    Key=key,
+                    Votes=mine,
+                    Need=need,
+                )
                 lead = Leadership(process, coordinators, info, key)
                 lead.start()
                 return lead
@@ -491,6 +508,13 @@ async def monitor_leader(
             if n >= _majority(len(coordinators)):
                 cur = out.get()
                 if cur is None or cur.change_id != info.change_id:
+                    trace(
+                        SevInfo,
+                        "LeaderChanged",
+                        process.address,
+                        Leader=info.address,
+                        ChangeId=info.change_id,
+                    )
                     out.set(info)
         await delay(POLL_DELAY)
 

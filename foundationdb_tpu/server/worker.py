@@ -130,6 +130,7 @@ class Worker:
     # -- registration (registrationClient, worker.actor.cpp:253) ---------------
 
     async def _registration_client(self):
+        registered_with = None  # CC address we last confirmed registration to
         while True:
             leader = self.leader.get()
             if leader is not None:
@@ -148,6 +149,15 @@ class Worker:
                         ),
                         self.knobs.HEARTBEAT_INTERVAL * 2,
                     )
+                    if registered_with != leader.address:
+                        registered_with = leader.address
+                        trace(
+                            SevInfo,
+                            "WorkerRegistered",
+                            self.process.address,
+                            CC=leader.address,
+                            Class=self.process_class,
+                        )
                 except Exception:
                     pass
             await delay(

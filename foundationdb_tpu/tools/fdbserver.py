@@ -83,6 +83,7 @@ def main(argv=None) -> int:
         data_dir=args.datadir,
         zone=args.zone,
         dc=args.dc,
+        die_on_actor_error=True,  # a server with a dead actor must crash loudly
     )
     world.activate()
 
