@@ -193,7 +193,47 @@ def bench_e2e():
     )
 
 
+def probe_device(max_tries=3):
+    """Probe JAX backend init in a SUBPROCESS with a hard timeout: a hung
+    TPU tunnel must not hang the bench (round-3 failure mode — the capture
+    died inside backend init with zero output). Returns the platform name
+    or None after retries with backoff."""
+    import subprocess
+
+    # When pinned to CPU, drop the axon TPU plugin's backend factory first:
+    # xla_bridge initializes every REGISTERED platform regardless of
+    # JAX_PLATFORMS, and a wedged tunnel then hangs even a CPU probe
+    # (same workaround as tests/conftest.py).
+    child = (
+        "import os\n"
+        "import jax\n"
+        "if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):\n"
+        "    import jax._src.xla_bridge as xb\n"
+        "    xb._backend_factories.pop('axon', None)\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
+        "print(jax.devices()[0].platform)\n"
+    )
+    for attempt in range(max_tries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", child],
+                capture_output=True,
+                text=True,
+                timeout=120,
+                env=dict(os.environ),
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip()
+            log(f"device probe attempt {attempt+1}: rc={r.returncode} "
+                f"{(r.stderr or '').strip()[-200:]}")
+        except subprocess.TimeoutExpired:
+            log(f"device probe attempt {attempt+1}: timed out (tunnel hang)")
+        time.sleep(5 * (attempt + 1))
+    return None
+
+
 def main():
+    global BATCHES, TXNS
     if os.environ.get("BENCH_COMPONENT") == "range_index":
         bench_range_index()
         return
@@ -201,7 +241,26 @@ def main():
         bench_e2e()
         return
     from foundationdb_tpu.conflict.native import NativeConflictSet
-    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+    # the device phase is gated on a probe; size the workload to what we
+    # actually run on (the full 200x2500 shape compiles+runs for minutes
+    # on a 1-core CPU host — fine on the chip, useless as a CI smoke)
+    platform = probe_device()
+    on_chip = platform in ("tpu", "axon")
+    if (
+        not on_chip
+        and "BENCH_BATCHES" not in os.environ
+        and "BENCH_TXNS" not in os.environ
+    ):
+        BATCHES, TXNS = 40, 640
+        log(f"platform={platform}: shrinking to {BATCHES}x{TXNS} smoke shape")
+    if platform == "cpu":
+        # mirror the probe's gate in this process before any jax use
+        import jax
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
 
     log(f"generating {BATCHES} batches x {TXNS} txns over {KEYSPACE} keys")
     batches = make_batches(BATCHES, TXNS)
@@ -221,6 +280,37 @@ def main():
         f"abort rate {aborts/(BATCHES*TXNS):.4f}, "
         f"boundaries {nat.boundary_count}"
     )
+
+    # STAGED OUTPUT: the native baseline is on record BEFORE any device
+    # work — a device failure below can no longer erase the whole run
+    # (the driver keeps the last JSON line; this one stands until the
+    # device phase replaces it)
+    print(
+        json.dumps(
+            {
+                "metric": "resolver_conflict_check_throughput",
+                "value": 0.0,
+                "unit": "txn/s",
+                "vs_baseline": 0.0,
+                "stage": "native_baseline_only",
+                "native_txn_s": round(nat_tps, 1),
+                "device": platform,
+            }
+        ),
+        flush=True,
+    )
+    if platform is None:
+        log("no usable JAX backend after retries; native baseline stands")
+        return
+
+    try:
+        _device_phase(batches, nat_tps, nat_verdicts)
+    except Exception as e:  # staged line above remains the result
+        log(f"device phase failed: {e!r}")
+
+
+def _device_phase(batches, nat_tps, nat_verdicts):
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
 
     # ---- TPU kernel (bucket-grid, conflict/grid.py) ----
     # key_width=12 keeps bench keys (8-9 B) exact with 3 uint32 lanes —
