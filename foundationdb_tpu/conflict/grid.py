@@ -358,20 +358,27 @@ def merge_writes(
         okok, jnp.concatenate([bc, bd]), B
     ).astype(jnp.int32)
 
-    # sort staged rows by code (bucket is a monotone function of code, so
-    # this also groups buckets contiguously), then AGGREGATE equal-code
-    # runs: one staged row per distinct boundary, carrying the run's event
-    # sum. Without this, a hot-key batch (many txns writing the same key)
+    # sort staged rows by (bucket, code) — bucket is a monotone function
+    # of code for valid rows, so this is code order with invalid rows
+    # (bkt = B) pushed strictly last — then AGGREGATE equal-code runs:
+    # one staged row per distinct boundary, carrying the run's event sum.
+    # Without this, a hot-key batch (many txns writing the same key)
     # would stage more same-code rows than any repivoting could split.
-    cols = tuple(codes[:, i] for i in range(L)) + (bkt, evs)
-    sorted_cols = jax.lax.sort(cols, num_keys=L)
-    scode = jnp.stack(sorted_cols[:L], axis=1)
-    sb = sorted_cols[L]
+    # Bucket must lead the sort keys: a VALID endpoint whose code is the
+    # all-0xFF sentinel (a clear_range to end-of-keyspace) would otherwise
+    # interleave with padding rows and break the run detection below.
+    cols = (bkt,) + tuple(codes[:, i] for i in range(L)) + (evs,)
+    sorted_cols = jax.lax.sort(cols, num_keys=L + 1)
+    sb = sorted_cols[0]
+    scode = jnp.stack(sorted_cols[1 : L + 1], axis=1)
     sev = sorted_cols[L + 1]
 
     valid = sb < B
     code_new = jnp.concatenate(
-        [jnp.ones(1, bool), (scode[1:] != scode[:-1]).any(axis=1)]
+        [
+            jnp.ones(1, bool),
+            (scode[1:] != scode[:-1]).any(axis=1) | (sb[1:] != sb[:-1]),
+        ]
     )
     code_last = jnp.concatenate([code_new[1:], jnp.ones(1, bool)])
     bkt_new = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])

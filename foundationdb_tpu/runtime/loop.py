@@ -97,6 +97,7 @@ class RealLoop(EventLoop):
     def __init__(self, seed: Optional[int] = None):
         import os as _os
         import selectors
+        from collections import deque
 
         if seed is None:
             seed = int.from_bytes(_os.urandom(8), "little")
@@ -104,6 +105,27 @@ class RealLoop(EventLoop):
         self._selector = selectors.DefaultSelector()
         self._t0 = self._monotonic()
         self._time = 0.0
+        # cross-thread handoff: worker threads (device waits, blocking IO)
+        # may not touch the heap; they append here and the loop drains at
+        # the top of each cycle (the select timeout bounds wakeup latency)
+        self._posted = deque()
+        # external work in flight (e.g. a resolver's device thread): the
+        # loop must not take the "nothing left to wait for" exit while a
+        # completion post is still coming. Both counters are mutated ONLY
+        # on the loop thread (begin at submit, end inside the posted
+        # completion), so no lock is needed.
+        self._external_pending = 0
+
+    def post(self, fn: Callable[[], None]) -> None:
+        """Schedule ``fn`` onto the loop from ANY thread (deque.append is
+        atomic). The reference's onMainThread (flow/ThreadHelper.actor.h)."""
+        self._posted.append(fn)
+
+    def external_begin(self) -> None:
+        self._external_pending += 1
+
+    def external_end(self) -> None:
+        self._external_pending -= 1
 
     @staticmethod
     def _monotonic() -> float:
@@ -166,6 +188,8 @@ class RealLoop(EventLoop):
         import selectors
 
         while not self.stopped:
+            while self._posted:
+                self.call_soon(self._posted.popleft())
             self._time = self._wall()
             # drain due callbacks
             while self._queue and self._queue[0][0] <= self._time:
@@ -178,7 +202,12 @@ class RealLoop(EventLoop):
                 return self._time
             if self._time >= until:
                 return self._time
-            if not self._queue and not self._selector.get_map():
+            if (
+                not self._queue
+                and not self._selector.get_map()
+                and self._external_pending == 0
+                and not self._posted
+            ):
                 return self._time  # nothing left to wait for
             wait = 0.05
             if self._queue:

@@ -20,10 +20,60 @@ The conflict check itself is the pluggable ConflictSet seam
 from __future__ import annotations
 
 from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
-from ..runtime.futures import VersionGate, delay
+from ..runtime.futures import Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
+
+
+class _SerialExecutor:
+    """One daemon thread running submitted thunks in order, resolving
+    their futures back on the event loop via ``loop.post``. The resolver's
+    device waits (TPU collects can block for a tunnel round trip or a
+    first-shape compile) run here so the worker's loop keeps servicing
+    heartbeats/elections — the role-thread split of the reference's
+    onMainThread bridging (flow/ThreadHelper.actor.h)."""
+
+    def __init__(self):
+        import queue
+        import threading
+
+        self._q = queue.Queue()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            fn, fut, loop = job
+
+            def finish(outcome, fut=fut, loop=loop):
+                # runs ON the loop thread: resolve + retire the external
+                # work marker in one scheduled step
+                err, result = outcome
+                loop.external_end()
+                if err is not None:
+                    fut._set_error(err)
+                else:
+                    fut._set(result)
+
+            try:
+                result = fn()
+            except BaseException as e:
+                loop.post(lambda e=e: finish((e, None)))
+            else:
+                loop.post(lambda r=result: finish((None, r)))
+
+    def submit(self, fn, loop) -> Future:
+        fut: Future = Future()
+        loop.external_begin()  # loop must not exit while this is in flight
+        self._q.put((fn, fut, loop))
+        return fut
+
+    def stop(self) -> None:
+        self._q.put(None)
 
 
 class Resolver:
@@ -52,6 +102,8 @@ class Resolver:
         self._pipelined = hasattr(self.cs, "detect_many_encoded_async")
         self.reply_gate = VersionGate(first_version)
         self.uid = uid
+        self._exec: _SerialExecutor = None  # created lazily on a RealLoop
+        self._broken: BaseException = None  # conflict backend failed fatally
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
         # version → [(committed, mutations)] for system-keyspace txns —
@@ -100,11 +152,28 @@ class Resolver:
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
         oldest = max(0, req.version - window)
         if self._pipelined:
-            self.cs.prepare(req.version)  # version-base rebase window
-            enc = self.cs.encode(txns)
-            handle = self.cs.detect_many_encoded_async(
-                [(enc, req.version, oldest)]
-            )
+            if self._broken is not None:
+                # a prior batch wedged/corrupted the device state: fail
+                # fast so recovery replaces this resolver instead of every
+                # proxy waiting on a gate that will never open. Both gates
+                # still advance, or the NEXT batch in the version chain
+                # would block forever at wait_until instead of failing too.
+                self.gate.advance_to(req.version)
+                self.reply_gate.advance_to(req.version)
+                raise RuntimeError(f"resolver backend failed: {self._broken!r}")
+
+            def dispatch(txns=txns, version=req.version, oldest=oldest):
+                self.cs.prepare(version)  # version-base rebase window
+                enc = self.cs.encode(txns)
+                return self.cs.detect_many_encoded_async([(enc, version, oldest)])
+
+            # all conflict-set work runs on one serial executor (RealLoop)
+            # or inline (sim): dispatch jobs enqueue in gate order here,
+            # collect jobs interleave behind later dispatches — so the
+            # device pipelines across batches while the loop never blocks
+            # on a device wait (a first-shape compile can outlast
+            # FAILURE_TIMEOUT and flap the whole worker otherwise)
+            dfut = self._submit(dispatch)
             # the device now owns the (prev → version) ordering for this
             # batch: open the gate and yield so the next batch in the
             # chain dispatches before we block on this one's verdicts
@@ -112,8 +181,17 @@ class Resolver:
             # applied at the resolver↔device boundary)
             self.gate.advance_to(req.version)
             await delay(0)
-            verdicts = handle()[0]
-            await self.reply_gate.wait_until(req.prev_version)
+            try:
+                handle = await dfut
+                verdicts = (await self._submit(handle))[0]
+                await self.reply_gate.wait_until(req.prev_version)
+            except BaseException as e:
+                # reply_gate must advance even on failure, or retransmit
+                # waiters (and every later batch) hang forever instead of
+                # seeing this resolver die and recovery replacing it
+                self._broken = e
+                self.reply_gate.advance_to(req.version)
+                raise
         else:
             verdicts = self.cs.detect_batch(
                 txns, now=req.version, new_oldest_version=oldest
@@ -153,6 +231,30 @@ class Resolver:
         else:
             self.gate.advance_to(req.version)
         return reply
+
+    def _submit(self, fn) -> Future:
+        """Run ``fn`` on the resolver's device thread (RealLoop) or inline
+        (sim loops stay single-threaded for determinism)."""
+        from ..runtime.loop import current_loop
+
+        loop = current_loop()
+        post = getattr(loop, "post", None)
+        if post is None:
+            fut: Future = Future()
+            try:
+                fut._set(fn())
+            except BaseException as e:
+                fut._set_error(e)
+            return fut
+        if self._exec is None:
+            self._exec = _SerialExecutor()
+        return self._exec.submit(fn, loop)
+
+    def close(self) -> None:
+        """Retire the role (worker._destroy): stop the device thread."""
+        if self._exec is not None:
+            self._exec.stop()
+            self._exec = None
 
     def register(self, process) -> None:
         process.register(Tokens.RESOLVE, self.resolve)

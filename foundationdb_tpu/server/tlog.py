@@ -379,10 +379,27 @@ class TLog:
             elif TXS_TAG in msgs and v > txs_popped:
                 if isinstance(msgs, Spilled):
                     new_log.append((v, Spilled({TXS_TAG})))
+                elif len(msgs) == 1:
+                    # already stripped to the txs sliver on a prior trim:
+                    # contents (and accounting) can't have changed
+                    new_log.append((v, msgs))
                 else:
-                    new_log.append((v, {TXS_TAG: msgs[TXS_TAG]}))
-                    # approximate: the retained txs sliver is small
-                    self._mem_bytes -= self._entry_bytes.pop(v, 0)
+                    sliver = {TXS_TAG: msgs[TXS_TAG]}
+                    new_log.append((v, sliver))
+                    # re-account the retained sliver at its estimated size
+                    # — subtracting the whole entry would let repeated
+                    # trims carry unbounded txs payloads past the spill
+                    # threshold unnoticed
+                    kept = 16 + sum(
+                        len(m)
+                        if isinstance(m, (bytes, bytearray))
+                        else len(getattr(m, "param1", b""))
+                        + len(getattr(m, "param2", b"") or b"")
+                        + 9
+                        for m in msgs[TXS_TAG]
+                    )
+                    self._mem_bytes -= self._entry_bytes.get(v, kept) - kept
+                    self._entry_bytes[v] = kept
             else:
                 self._mem_bytes -= self._entry_bytes.pop(v, 0)
         self._log = new_log

@@ -246,6 +246,12 @@ class Worker:
                     self.disk.remove(name)
         for a in h.actors:
             a.cancel()
+        close = getattr(h.obj, "close", None)
+        if close is not None:
+            try:
+                close()  # release non-actor resources (device threads)
+            except Exception:
+                pass
         trace(
             SevInfo, "RoleDestroyed", self.process.address, Kind=h.kind, Uid=h.uid
         )

@@ -141,3 +141,68 @@ def test_tpu_backend_survives_recovery():
         return True
 
     assert drive(sim, go(), limit=600.0)
+
+
+def test_resolver_backend_failure_does_not_wedge():
+    """A fatal conflict-backend error mid-pipeline must not wedge the
+    resolver's reply gate: later batches fail fast (so recovery can
+    replace the role) instead of blocking forever (ADVICE r3: gate
+    advance was skipped when handle() raised)."""
+    from foundationdb_tpu.runtime.futures import settled
+    from foundationdb_tpu.server.interfaces import (
+        ResolveBatchRequest,
+        TransactionData,
+    )
+    from foundationdb_tpu.server.resolver import Resolver
+
+    sim = Sim(seed=77)
+    sim.activate()
+    p = sim.new_process("res", "res")
+    r = Resolver(backend="tpu", first_version=0, uid="r0")
+    r.register_instance(p)
+
+    def req(prev, version):
+        return ResolveBatchRequest(
+            version=version,
+            prev_version=prev,
+            transactions=[
+                TransactionData(
+                    read_snapshot=0,
+                    read_conflict_ranges=[(b"a", b"b")],
+                    write_conflict_ranges=[(b"a", b"b")],
+                    mutations=[],
+                )
+            ],
+            last_receive_version=0,
+            requesting_proxy="px",
+        )
+
+    async def go():
+        ok = await r.resolve(req(0, 10))
+        assert ok.committed
+
+        # poison the backend: every later dispatch/collect raises
+        def boom(*a, **kw):
+            raise RuntimeError("device gone")
+
+        r.cs.detect_many_encoded_async = boom
+        err1 = None
+        try:
+            await r.resolve(req(10, 20))
+        except Exception as e:
+            err1 = e
+        assert err1 is not None
+        # subsequent batches must fail fast, not hang on either gate —
+        # including the one AFTER a fail-fast raise (the fail-fast path
+        # must advance the gates it skipped past)
+        for prev, ver in ((20, 30), (30, 40), (40, 50)):
+            err2 = None
+            try:
+                await r.resolve(req(prev, ver))
+            except Exception as e:
+                err2 = e
+            assert err2 is not None and "failed" in str(err2), (prev, ver)
+        return True
+
+    fut = spawn(go())
+    sim.run_until_done(fut, 60.0)
