@@ -28,7 +28,7 @@ BATCHES = int(os.environ.get("BENCH_BATCHES", "200"))
 TXNS = int(os.environ.get("BENCH_TXNS", "2500"))
 KEYSPACE = int(os.environ.get("BENCH_KEYSPACE", "1000000"))
 WINDOW = 50
-GROUP = int(os.environ.get("BENCH_GROUP", "20"))
+GROUP = int(os.environ.get("BENCH_GROUP", "40"))
 
 
 def log(msg):

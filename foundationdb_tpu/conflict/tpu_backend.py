@@ -29,6 +29,7 @@ from .api import CommitTransaction, ConflictSet, Verdict
 
 _INT32_REBASE_THRESHOLD = 1 << 30
 _SAMPLE_CAP = 131072
+_VERDICT_TABLE = [Verdict(i) for i in range(3)]
 
 
 def _bucket(n: int, floor: int = 1) -> int:
@@ -188,6 +189,14 @@ class TpuConflictSet(ConflictSet):
         self._state = state
         group["verdicts"] = verdicts
         group["pressure"] = pressure
+        # start the device→host copies NOW (they complete behind later
+        # dispatches): _collect's device_get then costs no extra tunnel
+        # round trip — with a remote device (axon tunnel) a synchronous
+        # fetch at collect time was a large fraction of the whole budget
+        for a in (verdicts, pressure):
+            copy_async = getattr(a, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
 
     def _collect(self, group) -> list[list[Verdict]]:
         if group["done"] is not None:
@@ -231,8 +240,12 @@ class TpuConflictSet(ConflictSet):
                 self._rebalance_wanted = False
             else:
                 self._rebalance_wanted = True
+        # table-indexed conversion over a plain python list: ~100× cheaper
+        # than Verdict(int(v)) per element (an IntEnum __call__ per txn was
+        # ~25% of the whole resolve budget at bench scale)
+        table = _VERDICT_TABLE
         group["done"] = [
-            [Verdict(int(v)) for v in out[g, : group["counts"][g]]]
+            [table[v] for v in out[g, : group["counts"][g]].tolist()]
             for g in range(len(group["counts"]))
         ]
         # collected groups can never be re-dispatched: drop everything
@@ -323,7 +336,7 @@ class TpuConflictSet(ConflictSet):
             out[: a.shape[0]] = a
             return out
 
-        return G.Batch(
+        stacked = G.Batch(
             rb=np.stack([pad3(b.rb, KR) for b in batches]),
             re=np.stack([pad3(b.re, KR) for b in batches]),
             wb=np.stack([pad3(b.wb, KW) for b in batches]),
@@ -331,6 +344,11 @@ class TpuConflictSet(ConflictSet):
             t_snap=np.stack([pad1(b.t_snap, np.int32) for b in batches]),
             t_has_reads=np.stack([pad1(b.t_has_reads, bool) for b in batches]),
         )
+        # upload asynchronously NOW: with pipelined dispatches the transfer
+        # overlaps earlier groups' device compute instead of stalling the
+        # dispatch inside the jit call (a ~46 ms/group synchronous upload
+        # over the tunnel otherwise)
+        return jax.tree_util.tree_map(jax.device_put, stacked)
 
     def _sample_key(self, key: bytes) -> None:
         self._sample_skip += 1

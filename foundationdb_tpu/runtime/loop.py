@@ -115,11 +115,31 @@ class RealLoop(EventLoop):
         # on the loop thread (begin at submit, end inside the posted
         # completion), so no lock is needed.
         self._external_pending = 0
+        # self-pipe: post() writes a byte so a loop parked in select()
+        # wakes immediately instead of at the 50 ms timeout (the reference
+        # wakes its reactor the same way, Net2's ASIOReactor::wake)
+        import socket as _socket
+
+        self._wake_r, self._wake_w = _socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self.add_reader(self._wake_r, self._drain_wake)
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
 
     def post(self, fn: Callable[[], None]) -> None:
         """Schedule ``fn`` onto the loop from ANY thread (deque.append is
         atomic). The reference's onMainThread (flow/ThreadHelper.actor.h)."""
         self._posted.append(fn)
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # pipe full = wakeup already pending
 
     def external_begin(self) -> None:
         self._external_pending += 1
@@ -204,7 +224,7 @@ class RealLoop(EventLoop):
                 return self._time
             if (
                 not self._queue
-                and not self._selector.get_map()
+                and len(self._selector.get_map()) <= 1  # wake pipe only
                 and self._external_pending == 0
                 and not self._posted
             ):

@@ -1,0 +1,160 @@
+"""Counters, rates, and latency samples — the analog of flow/Stats.h.
+
+The reference instruments every role with `Counter`s grouped into a
+`CounterCollection` traced periodically (flow/Stats.h:55-63 Counter /
+:101 CounterCollection; fdbserver/MasterProxyServer.actor.cpp:60
+ProxyStats, fdbserver/storageserver.actor.cpp:510 StorageServerMetrics).
+This module provides the same three primitives, loop-agnostic (sim or
+real time):
+
+- ``Counter``: monotonically growing total with per-interval delta, so a
+  trace shows both lifetime totals and current rate.
+- ``LatencySample``: bounded reservoir of durations answering p50/p95/p99
+  (the reference's LatencyBands / Sample, flow/Stats.h:140).
+- ``CounterCollection``: a named group; ``trace_loop()`` emits one trace
+  event per interval with every counter's total+rate and every sample's
+  percentiles, then resets interval state. ``snapshot()`` returns the
+  same data as a dict for the status document (Status.actor.cpp pulls
+  role metrics the same way).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .loop import now
+from .trace import SevInfo, trace
+
+
+class Counter:
+    __slots__ = ("name", "value", "_interval_start")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._interval_start = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+    def __iadd__(self, n: int) -> "Counter":
+        self.value += n
+        return self
+
+    @property
+    def interval_delta(self) -> int:
+        return self.value - self._interval_start
+
+    def reset_interval(self) -> None:
+        self._interval_start = self.value
+
+
+class LatencySample:
+    """Reservoir sample of durations (seconds). Bounded memory; exact
+    percentiles while under capacity, uniform reservoir beyond it."""
+
+    __slots__ = ("name", "cap", "count", "_buf", "_rnd")
+
+    def __init__(self, name: str, cap: int = 1024, seed: int = 0):
+        self.name = name
+        self.cap = cap
+        self.count = 0
+        self._buf: list[float] = []
+        self._rnd = random.Random(seed)
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        if len(self._buf) < self.cap:
+            self._buf.append(dt)
+        else:
+            i = self._rnd.randrange(self.count)
+            if i < self.cap:
+                self._buf[i] = dt
+
+    def percentile(self, p: float) -> float:
+        if not self._buf:
+            return 0.0
+        s = sorted(self._buf)
+        return s[min(int(len(s) * p), len(s) - 1)]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "p50": round(self.percentile(0.5), 6),
+            "p95": round(self.percentile(0.95), 6),
+            "p99": round(self.percentile(0.99), 6),
+        }
+
+
+class CounterCollection:
+    """A role's counters + samples, traced as one periodic event
+    (CounterCollection::logToTraceEvent, flow/Stats.cpp)."""
+
+    def __init__(self, name: str, ident: str = ""):
+        self.name = name
+        self.id = ident
+        self.counters: dict[str, Counter] = {}
+        self.samples: dict[str, LatencySample] = {}
+        self.gauges: dict[str, object] = {}  # name → zero-arg callable
+        self._last_trace = None
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def latency(self, name: str, cap: int = 1024) -> LatencySample:
+        s = self.samples.get(name)
+        if s is None:
+            s = self.samples[name] = LatencySample(name, cap)
+        return s
+
+    def gauge(self, name: str, fn) -> None:
+        """Register a zero-arg callable polled at snapshot/trace time
+        (the reference's SpecialCounter, flow/Stats.h:121)."""
+        self.gauges[name] = fn
+
+    def snapshot(self, elapsed: Optional[float] = None) -> dict:
+        out: dict = {"name": self.name, "id": self.id}
+        for n, c in self.counters.items():
+            out[n] = c.value
+            if elapsed and elapsed > 0:
+                out[n + "_hz"] = round(c.interval_delta / elapsed, 2)
+        for n, s in self.samples.items():
+            out[n] = s.snapshot()
+        for n, fn in self.gauges.items():
+            try:
+                out[n] = fn()
+            except Exception:
+                out[n] = None
+        return out
+
+    def trace_now(self, process: str = "") -> dict:
+        t = now()
+        elapsed = None if self._last_trace is None else t - self._last_trace
+        snap = self.snapshot(elapsed)
+        self._last_trace = t
+        for c in self.counters.values():
+            c.reset_interval()
+        trace(
+            SevInfo,
+            f"{self.name}Metrics",
+            process,
+            ID=self.id,
+            Elapsed=round(elapsed, 3) if elapsed is not None else None,
+            **{k: v for k, v in snap.items() if k not in ("name", "id")},
+        )
+        return snap
+
+    async def trace_loop(self, interval: float = 5.0, process: str = ""):
+        """Actor: trace this collection every ``interval`` seconds — the
+        per-role metrics logger every reference role runs
+        (e.g. masterProxyServerCore's traceRole counters)."""
+        from .futures import delay
+
+        self._last_trace = now()
+        while True:
+            await delay(interval)
+            self.trace_now(process)

@@ -222,14 +222,19 @@ class ClusterController:
         return True
 
     async def get_status(self, _req) -> dict:
-        """The cluster status document (Status.actor.cpp's aggregation,
-        trimmed to what this CC can see + quick storage polls)."""
+        """The cluster status document (Status.actor.cpp's aggregation):
+        topology from the registry, per-role metrics pulled from every
+        worker's CounterCollections (workerEvents), qos from the master's
+        ratekeeper, data/log health from role gauges."""
         info = self.db_info.get()
         workers = {}
         for d in self._alive_workers():
             workers[d.address] = {
                 "class": d.process_class,
                 "roles": list(d.roles),
+                "machine": d.machine,
+                "zone": d.zone,
+                "dc": d.dc,
             }
         doc = {
             "cluster": {
@@ -253,6 +258,72 @@ class ClusterController:
             doc["client"] = {
                 "proxies": [p.address for p in info.client_info.proxies]
             }
+
+        # per-process role metrics (parallel pulls; a dead worker times out
+        # without stalling the document)
+        async def pull(address):
+            try:
+                m = await timeout(
+                    self.process.request(
+                        Endpoint(address, "worker.metrics"), None
+                    ),
+                    1.0,
+                )
+                return address, m
+            except Exception:
+                return address, None
+
+        from ..runtime.futures import wait_for_all
+
+        pulls = await wait_for_all(
+            [self.process.spawn(pull(a)) for a in workers]
+        )
+        for address, metrics in pulls:
+            if metrics:
+                workers[address]["metrics"] = metrics
+
+        # aggregate sections (Status.actor.cpp's qos/data summaries).
+        # Gauges may snapshot as None on a transient error — treat as 0.
+        committed, durable = [], []
+        ops, txn_out, conflicts = 0, 0, 0
+        for w in workers.values():
+            for snap in (w.get("metrics") or {}).values():
+                kind = snap.get("kind")
+                if kind == "storage":
+                    committed.append(snap.get("version") or 0)
+                    durable.append(snap.get("durableVersion") or 0)
+                    ops += snap.get("finishedQueries") or 0
+                elif kind == "proxy":
+                    txn_out += snap.get("txnCommitOut") or 0
+                    conflicts += snap.get("txnConflicts") or 0
+        if committed:
+            doc["data"] = {
+                "max_storage_version": max(committed),
+                "min_durable_version": min(durable),
+                "storage_version_spread": max(committed) - min(committed),
+            }
+        doc["qos"] = {
+            "transactions_committed_total": txn_out,
+            "conflicts_total": conflicts,
+            "storage_finished_queries_total": ops,
+        }
+        # ratekeeper's released rate (master.getRate#uid on the master)
+        if info is not None and info.master_address:
+            try:
+                rate = await timeout(
+                    self.process.request(
+                        Endpoint(
+                            info.master_address,
+                            f"master.getRate#{info.master_uid}",
+                        ),
+                        None,
+                    ),
+                    1.0,
+                )
+                if rate is not None:
+                    doc["qos"]["released_transactions_per_second"] = rate
+            except Exception:
+                pass
         return doc
 
     # -- client openDatabase -----------------------------------------------------

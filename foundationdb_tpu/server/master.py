@@ -568,7 +568,14 @@ async def _seed_storage(process, picker: _RolePicker, n_storage, replication, m_
         await process.request(
             Endpoint(w.address, Tokens.WORKER_RECRUIT),
             RecruitRoleRequest(
-                role="storage", uid=s_uid, params=dict(tag=tag, ranges=ranges)
+                role="storage",
+                uid=s_uid,
+                # seed=True: displace a stale seed role from a racing
+                # first-recovery attempt (two same-generation masters can
+                # seed concurrently with divergent worker registries; only
+                # one survives the cstate write, and until that write
+                # nothing is durable, so the loser's roles are garbage)
+                params=dict(tag=tag, ranges=ranges, seed=True),
             ),
         )
         storage.append(StorageInterface(address=w.address, uid=s_uid, tag=tag))

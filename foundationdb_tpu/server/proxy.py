@@ -47,7 +47,9 @@ from ..runtime.futures import (
     wait_for_any,
 )
 from ..runtime.knobs import Knobs
+from ..runtime.loop import now
 from ..runtime.serialize import BinaryWriter, write_mutation
+from ..runtime.stats import CounterCollection
 from .systemdata import (
     PRIVATE_PREFIX,
     TXS_TAG,
@@ -183,11 +185,27 @@ class Proxy:
         # GRV batching toward the master (transactionStarter batching);
         # created lazily — self.process is bound at register() time
         self._grv_batcher = None
+        # ProxyStats (MasterProxyServer.actor.cpp:60): commit/GRV traffic
+        # counters + latency samples, traced periodically and served to the
+        # status aggregator via the metrics endpoint
+        self.stats = CounterCollection("Proxy", uid)
+        self._c_txn_in = self.stats.counter("txnCommitIn")
+        self._c_txn_committed = self.stats.counter("txnCommitOut")
+        self._c_txn_conflict = self.stats.counter("txnConflicts")
+        self._c_txn_too_old = self.stats.counter("txnTooOld")
+        self._c_grv_in = self.stats.counter("txnStartIn")
+        self._c_batches = self.stats.counter("commitBatchesOut")
+        self._c_mutations = self.stats.counter("mutations")
+        self._c_mutation_bytes = self.stats.counter("mutationBytes")
+        self._l_commit = self.stats.latency("commitLatency")
+        self._l_grv = self.stats.latency("grvLatency")
 
     # -- GRV -------------------------------------------------------------------
 
     async def get_read_version(self, _req: GetReadVersionRequest) -> GetReadVersionReply:
         self._check_alive()
+        self._c_grv_in.add()
+        t0 = now()
         # ratekeeper gate: new transactions wait for budget when storage
         # lags (transactionStarter's rate limiting, :925)
         while self._grv_budget is not None and self._grv_budget < 1.0:
@@ -206,6 +224,7 @@ class Proxy:
                 self._fetch_live_version, self.process.spawn
             )
         version = await self._grv_batcher.join()
+        self._l_grv.add(now() - t0)
         return GetReadVersionReply(version=version)
 
     async def _fetch_live_version(self):
@@ -259,12 +278,16 @@ class Proxy:
         if buggify():
             await delay(0.002)  # late-arriving commit (misses its batch)
         done: Future = Future()
+        self._c_txn_in.add()
+        t0 = now()
         self._batch.append((req.transaction, done))
         if len(self._batch) == 1:
             self._work._set(None)
         if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
             self._batch_trigger._set(None)
-        return await done
+        reply = await done
+        self._l_commit.add(now() - t0)
+        return reply
 
     async def batcher_loop(self):
         while True:
@@ -366,6 +389,10 @@ class Proxy:
                 if verdict != Verdict.COMMITTED:
                     continue
                 for m in substitute_versionstamps(txn.mutations, stamp):
+                    self._c_mutations.add()
+                    self._c_mutation_bytes.add(
+                        len(m.param1) + len(m.param2 or b"")
+                    )
                     if m.type == MutationType.CLEAR_RANGE:
                         tags = self.shards.tags_for_range(m.param1, m.param2)
                     else:
@@ -426,12 +453,16 @@ class Proxy:
             self.master.ep("reportCommitted"),
             ReportRawCommittedVersionRequest(version=version),
         )
+        self._c_batches.add()
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
+                self._c_txn_committed.add()
                 reply._set(CommitReply(version=version, versionstamp=stamp))
             elif verdict == Verdict.TOO_OLD:
+                self._c_txn_too_old.add()
                 reply._set_error(TransactionTooOld())
             else:
+                self._c_txn_conflict.add()
                 reply._set_error(NotCommitted())
 
     def _send_resolve(self, prev_version, version, txns):
@@ -526,13 +557,18 @@ class Proxy:
         if self.failed:
             raise BrokenPromise(f"proxy {self.uid} epoch {self.epoch} is dead")
 
+    async def _metrics(self, _req) -> dict:
+        return self.stats.snapshot()
+
     def register(self, process) -> None:
         """Well-known tokens (static cluster)."""
         self.process = process
         process.register(Tokens.GRV, self.get_read_version)
         process.register(Tokens.COMMIT, self.commit)
         process.register(Tokens.GET_KEY_SERVERS, self.get_key_servers)
+        process.register(f"proxy.metrics#{self.uid}", self._metrics)
         process.spawn(self.batcher_loop())
+        process.spawn(self.stats.trace_loop(5.0, process.address))
 
     def register_instance(self, process) -> None:
         """Endpoints only — the hosting worker owns the batcher actor."""
@@ -541,6 +577,7 @@ class Proxy:
         process.register(f"{Tokens.COMMIT}#{self.uid}", self.commit)
         process.register(f"{Tokens.GET_KEY_SERVERS}#{self.uid}", self.get_key_servers)
         process.register(f"proxy.ping#{self.uid}", self._ping)
+        process.register(f"proxy.metrics#{self.uid}", self._metrics)
 
     async def _ping(self, _req):
         self._check_alive()

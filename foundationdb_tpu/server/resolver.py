@@ -23,6 +23,7 @@ from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
 from ..runtime.futures import Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
+from ..runtime.stats import CounterCollection
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
 
 
@@ -110,6 +111,12 @@ class Resolver:
         # forwarded to every proxy so each applies metadata changes in
         # version order (recentStateTransactions, Resolver.actor.cpp:170)
         self._state_txns: dict[Version, list] = {}
+        # ResolverStats (Resolver.actor.cpp:48): batch/txn traffic
+        self.stats = CounterCollection("Resolver", uid)
+        self._c_batches = self.stats.counter("resolveBatchIn")
+        self._c_txns = self.stats.counter("transactions")
+        self._c_conflicts = self.stats.counter("conflicts")
+        self.stats.gauge("version", lambda: self.gate.version)
 
     @property
     def version(self) -> Version:
@@ -214,6 +221,11 @@ class Resolver:
         reply = ResolveBatchReply(
             committed=[int(v) for v in verdicts], state_mutations=state
         )
+        self._c_batches.add()
+        self._c_txns.add(len(verdicts))
+        self._c_conflicts.add(
+            sum(1 for v in verdicts if int(v) != int(Verdict.COMMITTED))
+        )
 
         self._replies[req.version] = reply
         # retire cached replies once EVERY proxy has moved past them — one
@@ -256,12 +268,17 @@ class Resolver:
             self._exec.stop()
             self._exec = None
 
+    async def _metrics(self, _req) -> dict:
+        return self.stats.snapshot()
+
     def register(self, process) -> None:
         process.register(Tokens.RESOLVE, self.resolve)
+        process.register(f"resolver.metrics#{self.uid}", self._metrics)
 
     def register_instance(self, process) -> None:
         process.register(f"{Tokens.RESOLVE}#{self.uid}", self.resolve)
         process.register(f"resolver.ping#{self.uid}", self._ping)
+        process.register(f"resolver.metrics#{self.uid}", self._metrics)
 
     async def _ping(self, _req):
         return "pong"

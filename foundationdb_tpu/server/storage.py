@@ -26,6 +26,7 @@ from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
+from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
     GetKeyValuesReply,
@@ -96,6 +97,19 @@ class StorageServer:
         # undo: [(version, begin, end, prior [(b, e, state)])]
         self._shard_events: list = []
         self._fetch_generation = 0  # bumped on rollback: in-flight fetches restart
+        # StorageServerMetrics (storageserver.actor.cpp:510): query/mutation
+        # traffic + version gauges for status and ratekeeper-style lag views
+        self.stats = CounterCollection("Storage", uid)
+        self._c_queries = self.stats.counter("finishedQueries")
+        self._c_rows = self.stats.counter("rowsQueried")
+        self._c_bytes_q = self.stats.counter("bytesQueried")
+        self._c_mutations = self.stats.counter("mutations")
+        self._c_mutation_bytes = self.stats.counter("mutationBytes")
+        self.stats.gauge("version", lambda: self.version.get())
+        self.stats.gauge("durableVersion", lambda: self.durable_version)
+        self.stats.gauge(
+            "windowVersions", lambda: self.version.get() - self.durable_version
+        )
 
     # -- mutation pull loop (update:2321) --------------------------------------
 
@@ -189,6 +203,8 @@ class StorageServer:
             )
 
     def _apply(self, m, version: Version) -> None:
+        self._c_mutations.add()
+        self._c_mutation_bytes.add(len(m.param1) + len(m.param2 or b""))
         if m.param1.startswith(PRIVATE_PREFIX):
             self._apply_private(m, version)
             return
@@ -572,6 +588,10 @@ class StorageServer:
         known, value = self.data.get_with_presence(req.key, req.version)
         if not known and self.engine is not None:
             value = self.engine.read_value(req.key)
+        self._c_queries.add()
+        if value is not None:
+            self._c_rows.add()
+            self._c_bytes_q.add(len(req.key) + len(value))
         return GetValueReply(value=value)
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
@@ -583,6 +603,9 @@ class StorageServer:
             req.begin, req.end, req.version, limit + 1, req.reverse
         )
         more = len(data) > limit
+        self._c_queries.add()
+        self._c_rows.add(min(len(data), limit))
+        self._c_bytes_q.add(sum(len(k) + len(v) for k, v in data[:limit]))
         return GetKeyValuesReply(data=data[:limit], more=more)
 
     def _read_range_merged(self, begin, end, version, limit, reverse):
@@ -684,12 +707,16 @@ class StorageServer:
         would come back with (old tlog generations must outlive it)."""
         return (self.version.get(), self.durable_version, self._followed_epoch)
 
+    async def _metrics(self, _req) -> dict:
+        return self.stats.snapshot()
+
     def register_endpoints(self, process) -> None:
         self.process = process
         process.register(Tokens.GET_VALUE, self.get_value)
         process.register(Tokens.GET_KEY_VALUES, self.get_key_values)
         process.register(f"storage.version#{self.uid}", self._get_version)
         process.register(f"storage.ping#{self.uid}", self._ping)
+        process.register(f"storage.metrics#{self.uid}", self._metrics)
         process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
         process.register(Tokens.WATCH_VALUE, self.watch_value)
         process.register(Tokens.BATCH_GET, self.batch_get)
@@ -699,6 +726,7 @@ class StorageServer:
         self.register_endpoints(process)
         process.spawn(self.pull_loop())
         process.spawn(self.durability_loop())
+        process.spawn(self.stats.trace_loop(5.0, process.address))
 
     async def run(self):
         """Worker-hosted lifetime: recover durable state first, then pull

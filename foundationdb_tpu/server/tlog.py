@@ -25,6 +25,7 @@ from ..kv.diskqueue import DiskQueue
 from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
+from ..runtime.stats import CounterCollection
 from .systemdata import TXS_TAG
 from .interfaces import (
     TLogCommitRequest,
@@ -108,6 +109,18 @@ class TLog:
         # evicted (Spilled markers) and served back from the DiskQueue
         self._entry_bytes: dict[Version, int] = {}
         self._mem_bytes = 0
+        # TLogMetrics (TLogServer.actor.cpp:348 TLogData counters)
+        self.stats = CounterCollection("TLog", log_id)
+        self._c_commits = self.stats.counter("commits")
+        self._c_bytes_in = self.stats.counter("bytesInput")
+        self._c_peeks = self.stats.counter("peeks")
+        self.stats.gauge("version", lambda: self.version.get())
+        self.stats.gauge("knownCommitted", lambda: self.known_committed)
+        self.stats.gauge("memBytes", lambda: self._mem_bytes)
+        self.stats.gauge(
+            "queueBytes",
+            lambda: self.dq.bytes_used if self.dq is not None else 0,
+        )
 
     async def recover(self) -> None:
         """Rebuild from the DiskQueue after a reboot
@@ -202,6 +215,8 @@ class TLog:
             # clamps at the epoch end version)
             raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
         self._gate.advance_to(req.version)
+        self._c_commits.add()
+        self._c_bytes_in.add(self._entry_bytes.get(req.version, 0))
         if req.known_committed > self.known_committed:
             self.known_committed = req.known_committed
         if req.version > self.version.get():
@@ -267,6 +282,7 @@ class TLog:
         return messages
 
     async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
+        self._c_peeks.add()
         # long-poll: wait until data through req.begin exists (a stopped
         # tlog's horizon is final — reply immediately with what it has)
         while self.version.get() < req.begin and not self.stopped:
@@ -390,16 +406,21 @@ class TLog:
                     # — subtracting the whole entry would let repeated
                     # trims carry unbounded txs payloads past the spill
                     # threshold unnoticed
-                    kept = 16 + sum(
-                        len(m)
-                        if isinstance(m, (bytes, bytearray))
-                        else len(getattr(m, "param1", b""))
-                        + len(getattr(m, "param2", b"") or b"")
-                        + 9
-                        for m in msgs[TXS_TAG]
-                    )
-                    self._mem_bytes -= self._entry_bytes.get(v, kept) - kept
-                    self._entry_bytes[v] = kept
+                    if v in self._entry_bytes:
+                        # only re-account entries that were ever counted:
+                        # a modeled (dq=None) tlog tracks no entry bytes,
+                        # and inventing them here would drive _mem_bytes
+                        # negative when the entry is finally dropped
+                        kept = 16 + sum(
+                            len(m)
+                            if isinstance(m, (bytes, bytearray))
+                            else len(getattr(m, "param1", b""))
+                            + len(getattr(m, "param2", b"") or b"")
+                            + 9
+                            for m in msgs[TXS_TAG]
+                        )
+                        self._mem_bytes -= self._entry_bytes[v] - kept
+                        self._entry_bytes[v] = kept
             else:
                 self._mem_bytes -= self._entry_bytes.pop(v, 0)
         self._log = new_log
@@ -410,6 +431,9 @@ class TLog:
             return self._versions[0] - 1
         return horizon
 
+    async def _metrics(self, _req) -> dict:
+        return self.stats.snapshot()
+
     def register_instance(self, process) -> None:
         """Id-suffixed tokens: many generations can share a worker."""
         process.register(f"tlog.commit#{self.log_id}", self.commit)
@@ -417,6 +441,7 @@ class TLog:
         process.register(f"tlog.pop#{self.log_id}", self.pop)
         process.register(f"tlog.lock#{self.log_id}", self.lock)
         process.register(f"tlog.ping#{self.log_id}", _pong)
+        process.register(f"tlog.metrics#{self.log_id}", self._metrics)
 
 
 async def _pong(_req):

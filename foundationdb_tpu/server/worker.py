@@ -76,6 +76,7 @@ class Worker:
         p.register(Tokens.WORKER_SET_DB_INFO, self.set_db_info)
         p.register(Tokens.WORKER_PING, self._ping)
         p.register(Tokens.WORKER_DESTROY_ROLE, self._destroy_role_req)
+        p.register("worker.metrics", self._role_metrics)
         p.spawn(self._rescan_disk())  # reboot: resurrect durable roles
         p.spawn(monitor_leader(p, self.coordinators, self.leader))
         p.spawn(self._registration_client())
@@ -121,6 +122,18 @@ class Worker:
 
     async def _ping(self, _req):
         return "pong"
+
+    async def _role_metrics(self, _req) -> dict:
+        """Snapshot every hosted role's CounterCollection — the status
+        aggregator's per-process pull (Status.actor.cpp's workerEvents)."""
+        out = {}
+        for uid, h in self.roles.items():
+            stats = getattr(h.obj, "stats", None)
+            if stats is not None:
+                snap = stats.snapshot()
+                snap["kind"] = h.kind
+                out[uid] = snap
+        return out
 
     async def _destroy_role_req(self, uid: str):
         """Operator-driven role destruction (the CC's forceRecovery)."""
@@ -237,13 +250,15 @@ class Worker:
         # recruitment returned (the master does, mid-recovery) — sweep them
         for token in [t for t in self.process.endpoints if t.endswith(f"#{uid}")]:
             self.process.endpoints.pop(token, None)
-        if h.kind == "tlog" and getattr(self, "disk", None) is not None:
-            # a destroyed tlog generation's durable state must not be
-            # resurrected on the next reboot
+        if getattr(self, "disk", None) is not None:
+            # a destroyed role's durable state must not be resurrected on
+            # the next reboot (a leftover storage manifest would make the
+            # reboot's _rescan_disk recruit TWO storage roles and fail)
             self.disk.remove(f"manifest-{uid}")
-            for name in list(self.disk.list()):
-                if name.startswith(f"tlog-{uid}."):
-                    self.disk.remove(name)
+            if h.kind == "tlog":
+                for name in list(self.disk.list()):
+                    if name.startswith(f"tlog-{uid}."):
+                        self.disk.remove(name)
         for a in h.actors:
             a.cancel()
         close = getattr(h.obj, "close", None)
@@ -301,6 +316,7 @@ class Worker:
             disk=self.disk,
         )
         h.epoch, h.obj = epoch, tl
+        self._spawn(h, tl.stats.trace_loop(5.0, self.process.address))
         if recover:
             # serve only after the DiskQueue replay: a peek against an
             # empty index would understate this replica's durable version
@@ -335,6 +351,7 @@ class Worker:
         )
         h.epoch, h.obj = epoch, r
         r.register_instance(self.process)
+        self._spawn(h, r.stats.trace_loop(5.0, self.process.address))
 
     def _make_proxy(
         self,
@@ -364,13 +381,40 @@ class Worker:
         pr.register_instance(self.process)
         self._spawn(h, pr.batcher_loop())
         self._spawn(h, pr.rate_poller())
+        self._spawn(h, pr.stats.trace_loop(5.0, self.process.address))
 
-    def _make_storage(self, h, tag=0, ranges=None, recover=False):
+    def _make_storage(self, h, tag=0, ranges=None, recover=False, seed=False):
         from .storage import StorageServer
 
         # storage keeps well-known data tokens: strictly one per process
         # (a second would shadow the first's endpoints)
         others = [x for x in self.roles.values() if x.kind == "storage" and x is not h]
+        if others and seed:
+            # first-recovery seeding displaces a stale seed role left by a
+            # racing same-generation master — but ONLY a role that has
+            # never applied a mutation (version 0): a delayed seed recruit
+            # arriving after the racing winner's recovery completed must
+            # not destroy a storage that holds live data. (A full fix
+            # would thread the master's coordination generation through
+            # recruitment; version-0 covers the bug class determinedly
+            # hit in sim — both losers die before any commit lands.)
+            empty = [
+                x
+                for x in others
+                if getattr(getattr(x.obj, "version", None), "get", lambda: 1)() == 0
+                and getattr(x.obj, "durable_version", 1) == 0
+            ]
+            if len(empty) == len(others):
+                for x in others:
+                    trace(
+                        SevWarn,
+                        "SeedStorageDisplaced",
+                        self.process.address,
+                        Old=x.uid,
+                        New=h.uid,
+                    )
+                    self._destroy(x.uid)
+                others = []
         if others:
             del self.roles[h.uid]
             raise RuntimeError(f"{self.process.address} already hosts storage")
@@ -392,6 +436,7 @@ class Worker:
         )
         h.obj = ss
         ss.register_endpoints(self.process)
+        self._spawn(h, ss.stats.trace_loop(5.0, self.process.address))
         if recover:
             self._spawn(h, ss.run())
         else:

@@ -1,0 +1,86 @@
+"""Replicate bench.py's timed TPU loop with per-stage timing."""
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import numpy as np
+
+from foundationdb_tpu.conflict.api import CommitTransaction
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+BATCHES, TXNS, KEYSPACE, WINDOW, GROUP, DEPTH = 200, 2500, 1000000, 50, 20, 3
+
+
+def make_batches(n, seed=0):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(n):
+        txs = []
+        for _ in range(TXNS):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(CommitTransaction(
+                read_snapshot=i,
+                read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)],
+            ))
+        out.append(txs)
+    return out
+
+
+batches = make_batches(BATCHES)
+cap = 1 << 17
+while cap < 4 * TXNS * WINDOW:
+    cap <<= 1
+tpu = TpuConflictSet(key_width=12, capacity=cap)
+tpu_enc = [tpu.encode(txs) for txs in batches]
+
+warm = TpuConflictSet(key_width=12, capacity=cap)
+warm_enc = [warm.encode(txs) for txs in batches[:GROUP]]
+t0 = time.time()
+warm.detect_many_encoded([(e, i + WINDOW, i) for i, e in enumerate(warm_enc)])
+warm._reshard(warm._state)
+print(f"compile+warmup: {time.time()-t0:.1f}s", flush=True)
+
+# instrument _dispatch and _collect
+orig_dispatch = tpu._dispatch
+orig_collect = tpu._collect
+t_dispatch = [0.0]
+t_collect = [0.0]
+n_redispatch = [0]
+
+def timed_dispatch(group):
+    t = time.perf_counter()
+    orig_dispatch(group)
+    t_dispatch[0] += time.perf_counter() - t
+    n_redispatch[0] += 1
+
+def timed_collect(group):
+    t = time.perf_counter()
+    r = orig_collect(group)
+    t_collect[0] += time.perf_counter() - t
+    return r
+
+tpu._dispatch = timed_dispatch
+tpu._collect = timed_collect
+
+t0 = time.time()
+handles = []
+n_done = 0
+for g in range(0, BATCHES, GROUP):
+    if len(handles) >= DEPTH:
+        vs = handles.pop(0)()
+        n_done += len(vs)
+    work = [(tpu_enc[i], i + WINDOW, i) for i in range(g, min(g + GROUP, BATCHES))]
+    handles.append(tpu.detect_many_encoded_async(work))
+for h in handles:
+    n_done += len(h())
+dt = time.time() - t0
+print(f"total: {dt:.2f}s = {dt/BATCHES*1000:.2f} ms/batch, {BATCHES*TXNS/dt/1e6:.3f} Mtxn/s")
+print(f"dispatch calls {n_redispatch[0]} time {t_dispatch[0]:.2f}s")
+print(f"collect time (incl. device wait) {t_collect[0]:.2f}s")

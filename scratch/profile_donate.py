@@ -1,0 +1,85 @@
+"""Does donation explain 20ms vs 6ms per batch in resolve_many?"""
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict.api import CommitTransaction
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+TXNS, KEYSPACE, WINDOW, GROUP = 2500, 1000000, 50, 20
+
+
+def make_batches(n, seed=0):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(n):
+        txs = []
+        for _ in range(TXNS):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(CommitTransaction(
+                read_snapshot=i,
+                read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)],
+            ))
+        out.append(txs)
+    return out
+
+
+batches = make_batches(40 + GROUP)
+cap = 1 << 17
+while cap < 4 * TXNS * WINDOW:
+    cap <<= 1
+tpu = TpuConflictSet(key_width=12, capacity=cap)
+enc = [tpu.encode(txs) for txs in batches]
+for g in range(0, 40, GROUP):
+    tpu.detect_many_encoded([(enc[i], i + WINDOW, i) for i in range(g, g + GROUP)])
+base_state = tpu._state
+
+stacked = jax.tree_util.tree_map(jnp.asarray, tpu._stack([e[0] for e in enc[40:40 + GROUP]]))
+nows = jnp.asarray([41 + WINDOW - tpu._base] * GROUP, jnp.int32)
+olds = jnp.asarray([41 - tpu._base] * GROUP, jnp.int32)
+
+# donated version (the production path)
+def run_donated():
+    st = jax.tree_util.tree_map(lambda x: x + 0, base_state)
+    out = G.resolve_many(st, stacked, nows, olds, olds)  # resolve_many donates
+    jax.block_until_ready(out)
+    return out
+
+# non-donated
+nod = jax.jit(G.resolve_many.__wrapped__)
+def run_nodonate():
+    out = nod(base_state, stacked, nows, olds, olds)
+    jax.block_until_ready(out)
+    return out
+
+for name, fn in [("donated", run_donated), ("no-donate", run_nodonate)]:
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        fn()
+    dt = (time.perf_counter() - t0) / 3
+    print(f"{name:12s} {dt/GROUP*1000:8.3f} ms/batch  ({GROUP*TXNS/dt/1e6:.3f} Mtxn/s)", flush=True)
+
+# host-side verdict conversion cost (what _collect does per group)
+from foundationdb_tpu.conflict.api import Verdict
+_st, verdicts, _pr = run_donated()
+out = np.asarray(jax.device_get(verdicts))
+t0 = time.perf_counter()
+res = [[Verdict(int(v)) for v in out[g, :TXNS]] for g in range(GROUP)]
+dt = time.perf_counter() - t0
+print(f"Verdict(int(v)) conversion: {dt/GROUP*1000:.3f} ms/batch")
+t0 = time.perf_counter()
+res2 = [out[g, :TXNS].tolist() for g in range(GROUP)]
+dt2 = time.perf_counter() - t0
+print(f"tolist() only:             {dt2/GROUP*1000:.3f} ms/batch")

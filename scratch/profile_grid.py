@@ -1,0 +1,157 @@
+"""Phase-level profiling of the bucket-grid kernel on the real chip.
+
+Times each kernel phase separately (jitted in isolation, donated where the
+real path donates) at the bench shape, plus the composed resolve_many, plus
+host-side stack/encode overhead — to find where the 23 ms/batch goes.
+"""
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict.api import CommitTransaction
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+BATCHES = 60
+TXNS = 2500
+KEYSPACE = 1000000
+WINDOW = 50
+GROUP = 20
+
+
+def log(m):
+    print(m, flush=True)
+
+
+def make_batches(n_batches, n_txns, seed=0):
+    rnd = random.Random(seed)
+    batches = []
+    for i in range(n_batches):
+        txs = []
+        for _ in range(n_txns):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(
+                CommitTransaction(
+                    read_snapshot=i,
+                    read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                    write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)],
+                )
+            )
+        batches.append(txs)
+    return batches
+
+
+def timeit(name, fn, n=20):
+    fn()  # warm
+    jax.effects_barrier()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+        out,
+    )
+    dt = (time.perf_counter() - t0) / n
+    log(f"{name:34s} {dt*1000:8.3f} ms")
+    return dt
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    batches = make_batches(BATCHES, TXNS)
+
+    cap = 1 << 17
+    while cap < 4 * TXNS * WINDOW:
+        cap <<= 1
+    tpu = TpuConflictSet(key_width=12, capacity=cap)
+    log(f"B={tpu._B} S={tpu._S} lanes={tpu._lanes}")
+
+    t0 = time.perf_counter()
+    enc = [tpu.encode(txs) for txs in batches]
+    log(f"encode: {(time.perf_counter()-t0)/BATCHES*1000:.2f} ms/batch")
+
+    # run a realistic prefix so the grid is populated like mid-bench
+    work = [(enc[i], i + WINDOW, i) for i in range(40)]
+    for g in range(0, 40, GROUP):
+        tpu.detect_many_encoded(work[g : g + GROUP])
+    state = tpu._state
+    log(
+        f"after 40 batches: live rows {int(np.asarray(state.count).sum())}, "
+        f"count max {int(np.asarray(state.count).max())}"
+    )
+
+    # host stack overhead
+    raw = [e[0] for e in enc[40 : 40 + GROUP]]
+    t0 = time.perf_counter()
+    for _ in range(5):
+        stacked = tpu._stack(raw)
+    log(f"host _stack({GROUP}): {(time.perf_counter()-t0)/5*1000:.2f} ms")
+
+    stacked_dev = jax.tree_util.tree_map(jnp.asarray, stacked)
+    batch1 = jax.tree_util.tree_map(lambda x: x[0], stacked_dev)
+
+    nows = np.asarray([41 + WINDOW - tpu._base] * GROUP, np.int32)
+    olds = np.asarray([41 - tpu._base] * GROUP, np.int32)
+    now1 = jnp.asarray(nows[0])
+    old1 = jnp.asarray(olds[0])
+
+    # individual phases (no donation: state reused)
+    jit_hist = jax.jit(G.history_conflicts)
+    H = jit_hist(state, batch1)
+
+    jit_intra = jax.jit(G.intra_batch_commits)
+    commit = jit_intra(batch1, H)
+
+    jit_merge = jax.jit(G.merge_writes)
+    timeit("history_conflicts", lambda: jit_hist(state, batch1))
+    timeit("intra_batch_commits", lambda: jit_intra(batch1, H))
+    timeit("merge_writes", lambda: jit_merge(state, batch1, commit, now1, old1))
+
+    # sub-phases of intra: the Pji compare alone vs the fixpoint
+    def pji_only(batch, H):
+        T, KR, L = batch.rb.shape
+        Pji = jnp.zeros((T, T), dtype=bool)
+        for ar in range(KR):
+            rb = batch.rb[:, ar, None, None, :]
+            re = batch.re[:, ar, None, None, :]
+            wb = batch.wb[None, :, :, :]
+            we = batch.we[None, :, :, :]
+            o = G.lex_lt(rb, we) & G.lex_lt(wb, re)
+            Pji = Pji | o.any(axis=2)
+        return Pji
+
+    jit_pji = jax.jit(pji_only)
+    timeit("  intra: Pji compare only", lambda: jit_pji(batch1, H))
+
+    # composed single batch
+    jit_one = jax.jit(G.resolve_batch, donate_argnames=())
+
+    def one():
+        return jit_one(state, batch1, now1, old1, old1)
+
+    timeit("resolve_batch (1 batch, no donate)", one, n=10)
+
+    # composed group of 20 via resolve_many (no donation for repeat)
+    jit_many = jax.jit(G.resolve_many, donate_argnames=())
+
+    def many():
+        return jit_many(state, stacked_dev, jnp.asarray(nows), jnp.asarray(olds), jnp.asarray(olds))
+
+    dt = timeit(f"resolve_many (group of {GROUP})", many, n=3)
+    log(f"  => per-batch {dt/GROUP*1000:.3f} ms, per-txn throughput {GROUP*TXNS/dt/1e6:.3f} Mtxn/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+"""Decompose resolve_many cost by running scan variants with phases stubbed.
+
+Timing-only (verdict correctness irrelevant for stubs); each variant is the
+same lax.scan over 20 batches with donated state, differing in which pieces
+of the step run. Differences between variants attribute in-scan time."""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict.api import CommitTransaction
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+TXNS = 2500
+KEYSPACE = 1000000
+WINDOW = 50
+GROUP = 20
+
+
+def log(m):
+    print(m, flush=True)
+
+
+def make_batches(n_batches, n_txns, seed=0):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(n_batches):
+        txs = []
+        for _ in range(n_txns):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(
+                CommitTransaction(
+                    read_snapshot=i,
+                    read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                    write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)],
+                )
+            )
+        out.append(txs)
+    return out
+
+
+def merge_variant(state, batch, commit, now, oldest, *, parts):
+    """Reimplementation of merge_writes with sections gated by `parts`."""
+    B, S, Lp1 = state.grid.shape
+    L = Lp1 - 1
+    T, KW, _ = batch.wb.shape
+    Wtot = T * KW
+    S2 = G.staging_slots(S)
+    U = min(2 * Wtot, B)
+
+    w_ok = G.lex_lt(batch.wb, batch.we) & commit[:, None]
+    c = batch.wb.reshape(Wtot, L)
+    d = batch.we.reshape(Wtot, L)
+    ok = w_ok.reshape(Wtot)
+    okok = jnp.concatenate([ok, ok])
+
+    if "rank" in parts:
+        bc = G._rank_le(c, state.pivots)
+        bd = G._rank_le(d, state.pivots)
+    else:
+        bc = jnp.zeros((Wtot,), jnp.int32)
+        bd = jnp.zeros((Wtot,), jnp.int32)
+
+    codes = jnp.concatenate([c, d], axis=0)
+    codes = jnp.where(okok[:, None], codes, G.SENTINEL)
+    evs = jnp.concatenate([jnp.where(ok, 1, 0), jnp.where(ok, -1, 0)]).astype(jnp.int32)
+    bkt = jnp.where(okok, jnp.concatenate([bc, bd]), B).astype(jnp.int32)
+
+    if "sort1" in parts:
+        cols = (bkt,) + tuple(codes[:, i] for i in range(L)) + (evs,)
+        sorted_cols = jax.lax.sort(cols, num_keys=L + 1)
+        sb = sorted_cols[0]
+        scode = jnp.stack(sorted_cols[1 : L + 1], axis=1)
+        sev = sorted_cols[L + 1]
+    else:
+        sb, scode, sev = bkt, codes, evs
+
+    valid = sb < B
+    code_new = jnp.concatenate(
+        [jnp.ones(1, bool), (scode[1:] != scode[:-1]).any(axis=1) | (sb[1:] != sb[:-1])]
+    )
+    code_last = jnp.concatenate([code_new[1:], jnp.ones(1, bool)])
+    bkt_new = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+    bkt_last = jnp.concatenate([bkt_new[1:], jnp.ones(1, bool)])
+
+    pe = jnp.cumsum(sev)
+    pe_prev = jnp.concatenate([jnp.zeros(1, jnp.int32), pe[:-1]])
+    pe_before_run = G._log_shift_fill(
+        jnp.where(code_new, pe_prev, 0)[None, :], code_new[None, :]
+    )[0]
+    agg_ev = pe - pe_before_run
+    pe_before_bkt = G._log_shift_fill(
+        jnp.where(bkt_new, pe_prev, 0)[None, :], bkt_new[None, :]
+    )[0]
+    bkt_ev = pe - pe_before_bkt
+
+    ucum = jnp.cumsum((bkt_new & valid).astype(jnp.int32)) - 1
+    ccum = jnp.cumsum((code_new & valid).astype(jnp.int32))
+    ccum_at_bkt = G._log_shift_fill(
+        jnp.where(bkt_new, ccum - 1, 0)[None, :], bkt_new[None, :]
+    )[0]
+    slot = ccum - 1 - ccum_at_bkt
+    max_staged = jnp.max(jnp.where(code_last & valid, slot + 1, 0))
+
+    flat = jnp.where(code_last & valid & (slot < S2), ucum * S2 + slot, U * S2)
+    st_code = jnp.full((U * S2 + 1, L), G.SENTINEL, dtype=jnp.uint32)
+    st_code = st_code.at[flat].set(scode, mode="drop")[: U * S2].reshape(U, S2, L)
+    st_ev = jnp.zeros((U * S2 + 1,), jnp.int32).at[flat].set(agg_ev, mode="drop")[
+        : U * S2
+    ].reshape(U, S2)
+
+    tid = jnp.full((U + 1,), B, jnp.int32).at[
+        jnp.where(bkt_new & valid, ucum, U)
+    ].set(sb, mode="drop")[:U]
+
+    evsum_B = jnp.zeros((B + 1,), jnp.int32).at[
+        jnp.where(bkt_last & valid, sb, B)
+    ].add(jnp.where(bkt_last & valid, bkt_ev, 0), mode="drop")[:B]
+    carry = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(evsum_B)[:-1]])
+
+    tid_c = jnp.minimum(tid, B - 1)
+    u_live = tid < B
+    if "gather" in parts:
+        old = state.grid[tid_c]
+        old_used = (jnp.arange(S)[None, :] < state.count[tid_c][:, None]) & u_live[:, None]
+        old_code = jnp.where(old_used[..., None], old[..., :L], G.SENTINEL)
+        old_ver = jnp.where(old_used, old[..., L].astype(jnp.int32), 0)
+    else:
+        old_code = jnp.full((U, S, L), G.SENTINEL, jnp.uint32)
+        old_ver = jnp.zeros((U, S), jnp.int32)
+
+    M = S + S2
+    m_code = jnp.concatenate([old_code, st_code], axis=1)
+    m_ver = jnp.concatenate([old_ver, jnp.zeros((U, S2), jnp.int32)], axis=1)
+    m_ev = jnp.concatenate([jnp.zeros((U, S), jnp.int32), st_ev], axis=1)
+    m_old = jnp.concatenate(
+        [ (old_ver > -1).astype(jnp.int32) if "gather" not in parts else (old_code != G.SENTINEL).any(-1).astype(jnp.int32), jnp.zeros((U, S2), jnp.int32)], axis=1
+    )
+
+    if "sort2" in parts:
+        cols = tuple(m_code[..., i] for i in range(L)) + (m_ver, m_ev, m_old)
+        sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=L)
+        g_code = jnp.stack(sorted_cols[:L], axis=-1)
+        g_ver = sorted_cols[L]
+        g_ev = sorted_cols[L + 1]
+        g_old = sorted_cols[L + 2].astype(bool)
+    else:
+        g_code, g_ver, g_ev, g_old = m_code, m_ver, m_ev, m_old.astype(bool)
+
+    base = G._log_shift_fill(jnp.where(g_old, g_ver, 0), g_old)
+    carry_in = jnp.where(u_live, carry[tid_c], 0)
+    cov = carry_in[:, None] + jnp.cumsum(g_ev, axis=1)
+    covered = cov > 0
+    nv = jnp.where(covered, jnp.maximum(base, now), base)
+    nv = jnp.where(nv < oldest, 0, nv)
+
+    is_sent = (g_code == G.SENTINEL).all(axis=-1)
+    nxt_differs = jnp.concatenate(
+        [(g_code[:, 1:] != g_code[:, :-1]).any(axis=-1), jnp.ones((U, 1), bool)], axis=1
+    )
+    keep = (~is_sent) & nxt_differs
+    shifted_nv = jnp.pad(nv, ((0, 0), (1, 0)), constant_values=-1)[:, :M]
+    first_of_run = jnp.concatenate(
+        [jnp.ones((U, 1), bool), (g_code[:, 1:] != g_code[:, :-1]).any(axis=-1)], axis=1
+    )
+    pval = G._log_shift_fill(jnp.where(first_of_run, shifted_nv, 0), first_of_run)
+    keep = keep & (nv != pval)
+
+    kept_cnt = keep.sum(axis=1, dtype=jnp.int32)
+    max_kept = jnp.max(jnp.where(u_live, kept_cnt, 0))
+
+    if "sort3" in parts:
+        cols = (jnp.where(keep, 0, 1).astype(jnp.int32),) + tuple(
+            g_code[..., i] for i in range(L)
+        ) + (nv,)
+        sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=1, is_stable=True)
+        out_code = jnp.stack(sorted_cols[1 : L + 1], axis=-1)[:, :S, :]
+        out_ver = sorted_cols[L + 1][:, :S]
+    else:
+        out_code = g_code[:, :S, :]
+        out_ver = nv[:, :S]
+
+    new_count_u = jnp.minimum(kept_cnt, S)
+    used = jnp.arange(S)[None, :] < new_count_u[:, None]
+    out_code = jnp.where(used[..., None], out_code, G.SENTINEL)
+    out_ver = jnp.where(used, out_ver, 0)
+    out_rows = jnp.concatenate([out_code, out_ver.astype(jnp.uint32)[..., None]], axis=-1)
+    out_bmax = jnp.max(out_ver, axis=1)
+
+    if "scatter" in parts:
+        new_grid = state.grid.at[tid].set(out_rows, mode="drop")
+        new_count = state.count.at[tid].set(new_count_u, mode="drop")
+        new_bmax = state.bmax.at[tid].set(out_bmax, mode="drop")
+    else:
+        new_grid, new_count, new_bmax = state.grid, state.count, state.bmax
+
+    if "collapse" in parts:
+        is_touched = jnp.zeros((B + 1,), bool).at[tid].set(True, mode="drop")[:B]
+        covered_b = (carry > 0) & ~is_touched
+        collapsed = jnp.full((B, S, Lp1), G.SENTINEL, dtype=jnp.uint32)
+        collapsed = collapsed.at[:, :, L].set(0)
+        collapsed = collapsed.at[:, 0, :L].set(state.pivots)
+        collapsed = collapsed.at[:, 0, L].set(now.astype(jnp.uint32))
+        cmask = covered_b[:, None, None]
+        new_grid = jnp.where(cmask, collapsed, new_grid)
+        new_count = jnp.where(covered_b, 1, new_count)
+        new_bmax = jnp.where(covered_b, now, new_bmax)
+
+    pressure = jnp.stack([max_staged, max_kept])
+    return G.GridState(state.pivots, new_grid, new_count, new_bmax), pressure
+
+
+ALL = {"rank", "sort1", "gather", "sort2", "sort3", "scatter", "collapse"}
+
+
+def make_runner(parts, do_history, do_intra):
+    @functools.partial(jax.jit, donate_argnames=("state",))
+    def run(state, batches, nows, olds_pre, olds_post):
+        def step(st, inp):
+            batch, now, old_pre, old_post = inp
+            if do_history:
+                H = G.history_conflicts(st, batch) | (
+                    batch.t_has_reads & (batch.t_snap < old_pre)
+                )
+            else:
+                H = batch.t_snap < old_pre
+            if do_intra:
+                commit = G.intra_batch_commits(batch, H)
+            else:
+                commit = ~H
+            st2, pressure = merge_variant(
+                st, batch, commit, now, old_post, parts=parts
+            )
+            return st2, pressure
+
+        state, pressures = jax.lax.scan(
+            step, state, (batches, nows, olds_pre, olds_post)
+        )
+        return state, pressures
+
+    return run
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    batches = make_batches(40 + GROUP, TXNS)
+    cap = 1 << 17
+    while cap < 4 * TXNS * WINDOW:
+        cap <<= 1
+    tpu = TpuConflictSet(key_width=12, capacity=cap)
+    enc = [tpu.encode(txs) for txs in batches]
+    work = [(enc[i], i + WINDOW, i) for i in range(40)]
+    for g in range(0, 40, GROUP):
+        tpu.detect_many_encoded(work[g : g + GROUP])
+    base_state = tpu._state
+    log(f"B={tpu._B} S={tpu._S} live={int(np.asarray(base_state.count).sum())}")
+
+    stacked = tpu._stack([e[0] for e in enc[40 : 40 + GROUP]])
+    stacked = jax.tree_util.tree_map(jnp.asarray, stacked)
+    nows = jnp.asarray([41 + WINDOW - tpu._base] * GROUP, jnp.int32)
+    olds = jnp.asarray([41 - tpu._base] * GROUP, jnp.int32)
+
+    variants = [
+        ("FULL", ALL, True, True),
+        ("no history", ALL, False, True),
+        ("no intra", ALL, True, False),
+        ("merge only", ALL, False, False),
+        ("merge -collapse", ALL - {"collapse"}, False, False),
+        ("merge -scatter-collapse", ALL - {"scatter", "collapse"}, False, False),
+        ("merge -sort2", ALL - {"sort2"}, False, False),
+        ("merge -sort3", ALL - {"sort3"}, False, False),
+        ("merge -sort2-sort3", ALL - {"sort2", "sort3"}, False, False),
+        ("merge -gather", ALL - {"gather", "sort2", "sort3", "scatter", "collapse"}, False, False),
+        ("merge -rank", ALL - {"rank"}, False, False),
+        ("merge skeleton(sort1 only)", {"sort1"}, False, False),
+    ]
+    for name, parts, hist, intra in variants:
+        run = make_runner(frozenset(parts), hist, intra)
+
+        def go():
+            st = jax.tree_util.tree_map(lambda x: x + 0, base_state)
+            out = run(st, stacked, nows, olds, olds)
+            jax.block_until_ready(out)
+            return out
+
+        go()  # compile
+        t0 = time.perf_counter()
+        n = 3
+        for _ in range(n):
+            go()
+        dt = (time.perf_counter() - t0) / n
+        # subtract the state copy cost? measure it once
+        log(f"{name:28s} {dt/GROUP*1000:8.3f} ms/batch")
+
+
+if __name__ == "__main__":
+    main()
