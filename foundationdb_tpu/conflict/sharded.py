@@ -71,6 +71,7 @@ def make_sharded_states(
                 grid=jnp.asarray(grid),
                 count=jnp.asarray(count),
                 bmax=jnp.zeros((n_buckets,), jnp.int32),
+                floor=jnp.zeros((n_buckets,), jnp.int32),
             )
         )
     return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
@@ -161,7 +162,7 @@ def build_sharded_resolver(mesh: Mesh, lanes: int):
         )
 
     state_spec = jax.tree.map(
-        lambda _: P("part"), G.GridState(0, 0, 0, 0)
+        lambda _: P("part"), G.GridState(0, 0, 0, 0, 0)
     )
     batch_spec = G.Batch(
         rb=P(None, "data"),

@@ -132,6 +132,22 @@ class RealLoop(EventLoop):
         except (BlockingIOError, OSError):
             pass
 
+    def close(self) -> None:
+        """Release the wake pipe (a loop is one-per-process in production,
+        but tests create many)."""
+        try:
+            self.remove_reader(self._wake_r)
+        except Exception:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __del__(self):  # backstop for leak-prone test loops
+        self.close()
+
     def post(self, fn: Callable[[], None]) -> None:
         """Schedule ``fn`` onto the loop from ANY thread (deque.append is
         atomic). The reference's onMainThread (flow/ThreadHelper.actor.h)."""

@@ -285,9 +285,12 @@ class Proxy:
             self._work._set(None)
         if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
             self._batch_trigger._set(None)
-        reply = await done
-        self._l_commit.add(now() - t0)
-        return reply
+        try:
+            return await done
+        finally:
+            # failures (conflict/too-old) are client-observed commit
+            # latency too — sample them all
+            self._l_commit.add(now() - t0)
 
     async def batcher_loop(self):
         while True:

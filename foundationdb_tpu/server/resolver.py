@@ -116,6 +116,7 @@ class Resolver:
         self._c_batches = self.stats.counter("resolveBatchIn")
         self._c_txns = self.stats.counter("transactions")
         self._c_conflicts = self.stats.counter("conflicts")
+        self._c_too_old = self.stats.counter("tooOld")
         self.stats.gauge("version", lambda: self.gate.version)
 
     @property
@@ -224,7 +225,10 @@ class Resolver:
         self._c_batches.add()
         self._c_txns.add(len(verdicts))
         self._c_conflicts.add(
-            sum(1 for v in verdicts if int(v) != int(Verdict.COMMITTED))
+            sum(1 for v in verdicts if int(v) == int(Verdict.CONFLICT))
+        )
+        self._c_too_old.add(
+            sum(1 for v in verdicts if int(v) == int(Verdict.TOO_OLD))
         )
 
         self._replies[req.version] = reply

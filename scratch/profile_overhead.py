@@ -1,0 +1,113 @@
+"""Per-call accounting of the production loop's host overhead."""
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import numpy as np
+
+from foundationdb_tpu.conflict import grid as G
+from foundationdb_tpu.conflict.api import CommitTransaction
+from foundationdb_tpu.conflict import tpu_backend as TB
+
+BATCHES, TXNS, KEYSPACE, WINDOW, GROUP, DEPTH = 200, 2500, 1000000, 50, 40, 3
+
+T = {}
+
+
+def acc(name, dt):
+    T[name] = T.get(name, 0.0) + dt
+
+
+def make_batches(n, seed=0):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(n):
+        txs = []
+        for _ in range(TXNS):
+            a = rnd.randrange(KEYSPACE)
+            b = a + 1 + rnd.randrange(10)
+            c = rnd.randrange(KEYSPACE)
+            d = c + 1 + rnd.randrange(10)
+            txs.append(CommitTransaction(read_snapshot=i,
+                read_conflict_ranges=[(b"%08d" % a, b"%08d" % b)],
+                write_conflict_ranges=[(b"%08d" % c, b"%08d" % d)]))
+        out.append(txs)
+    return out
+
+
+batches = make_batches(BATCHES)
+cap = 1 << 17
+while cap < 4 * TXNS * WINDOW:
+    cap <<= 1
+tpu = TB.TpuConflictSet(key_width=12, capacity=cap)
+enc = [tpu.encode(txs) for txs in batches]
+warm = TB.TpuConflictSet(key_width=12, capacity=cap)
+warm_enc = [warm.encode(txs) for txs in batches[:GROUP]]
+warm.detect_many_encoded([(e, i + WINDOW, i) for i, e in enumerate(warm_enc)])
+warm._reshard(warm._state)
+print("warm done", flush=True)
+
+orig_stack = tpu._stack
+def stack_timed(b):
+    t = time.perf_counter(); r = orig_stack(b); acc("stack+device_put", time.perf_counter() - t); return r
+tpu._stack = stack_timed
+
+orig_resolve_many = G.resolve_many
+def rm_timed(*a, **k):
+    t = time.perf_counter(); r = orig_resolve_many(*a, **k); acc("resolve_many call", time.perf_counter() - t); return r
+G.resolve_many = rm_timed
+
+orig_tm = jax.tree_util.tree_map
+def _snap_copy(state):
+    t = time.perf_counter()
+    r = orig_tm(lambda x: x + 0, state)
+    acc("snapshot copy", time.perf_counter() - t)
+    return r
+
+orig_dispatch = TB.TpuConflictSet._dispatch
+def dispatch_timed(self, group):
+    t = time.perf_counter()
+    metas = group["metas"]
+    nows = np.asarray([m[0] - self._base for m in metas], np.int32)
+    olds_pre = np.asarray([max(m[1] - self._base, 0) for m in metas], np.int32)
+    olds_post = np.asarray([max(m[2] - self._base, 0) for m in metas], np.int32)
+    group["snapshot"] = _snap_copy(self._state)
+    state, verdicts, pressure = G.resolve_many(self._state, group["stacked"], nows, olds_pre, olds_post)
+    self._state = state
+    group["verdicts"] = verdicts
+    group["pressure"] = pressure
+    t2 = time.perf_counter()
+    for a in (verdicts, pressure):
+        ca = getattr(a, "copy_to_host_async", None)
+        if ca is not None:
+            ca()
+    acc("copy_to_host_async", time.perf_counter() - t2)
+    acc("dispatch total", time.perf_counter() - t)
+tpu._dispatch = dispatch_timed.__get__(tpu)
+
+orig_get = jax.device_get
+def get_timed(x):
+    t = time.perf_counter(); r = orig_get(x); acc("device_get", time.perf_counter() - t); return r
+jax.device_get = get_timed
+
+t0 = time.time()
+handles = []
+n = 0
+for g in range(0, BATCHES, GROUP):
+    if len(handles) >= DEPTH:
+        n += len(handles.pop(0)())
+    work = [(enc[i], i + WINDOW, i) for i in range(g, min(g + GROUP, BATCHES))]
+    t = time.perf_counter()
+    handles.append(tpu.detect_many_encoded_async(work))
+    acc("async dispatch wrapper", time.perf_counter() - t)
+for h in handles:
+    t = time.perf_counter()
+    n += len(h())
+    acc("collect wrapper", time.perf_counter() - t)
+dt = time.time() - t0
+print(f"total {dt:.2f}s = {dt/BATCHES*1000:.2f} ms/batch, {BATCHES*TXNS/dt/1e6:.3f} Mtxn/s")
+for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
+    print(f"  {k:24s} {v:.3f}s")

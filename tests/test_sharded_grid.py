@@ -87,7 +87,7 @@ def test_sharded_matches_single_device_and_oracle():
     rnd = random.Random(11)
 
     states = sharded.make_sharded_states(n_part, B, S, L)
-    spec = jax.tree.map(lambda _: NamedSharding(mesh, P("part")), G.GridState(0, 0, 0, 0))
+    spec = jax.tree.map(lambda _: NamedSharding(mesh, P("part")), G.GridState(0, 0, 0, 0, 0))
     states = jax.device_put(states, spec)
     step = sharded.build_sharded_resolver(mesh, lanes=L)
 
@@ -135,7 +135,7 @@ def test_sharded_reshard_on_overflow():
     rnd = random.Random(13)
 
     states = sharded.make_sharded_states(n_part, B, S, L)
-    spec = jax.tree.map(lambda _: NamedSharding(mesh, P("part")), G.GridState(0, 0, 0, 0))
+    spec = jax.tree.map(lambda _: NamedSharding(mesh, P("part")), G.GridState(0, 0, 0, 0, 0))
     states = jax.device_put(states, spec)
     step = sharded.build_sharded_resolver(mesh, lanes=L)
     grown = {p: (B, S) for p in range(n_part)}
