@@ -81,7 +81,13 @@ class ConflictBatch:
 
 
 def new_conflict_set(backend: str = "oracle", **kwargs) -> ConflictSet:
-    """The ``newConflictSet()`` factory seam (ConflictSet.h:28)."""
+    """The ``newConflictSet()`` factory seam (ConflictSet.h:28).
+
+    ``tpu`` auto-upgrades to the mesh backend when more than one device is
+    visible — the cluster resolver then shards its conflict index across
+    the whole mesh (key-range partitioning, conflict/sharded.py) with no
+    configuration. ``mesh`` / ``tpu1`` force the choice either way.
+    """
     if backend == "oracle":
         from .oracle import OracleConflictSet
 
@@ -91,7 +97,25 @@ def new_conflict_set(backend: str = "oracle", **kwargs) -> ConflictSet:
 
         return NativeConflictSet(**kwargs)
     if backend == "tpu":
+        try:
+            import jax
+
+            multi = len(jax.devices()) > 1
+        except Exception:
+            multi = False
+        if multi:
+            from .mesh_backend import MeshConflictSet
+
+            return MeshConflictSet(**kwargs)
         from .tpu_backend import TpuConflictSet
 
         return TpuConflictSet(**kwargs)
+    if backend == "tpu1":
+        from .tpu_backend import TpuConflictSet
+
+        return TpuConflictSet(**kwargs)
+    if backend == "mesh":
+        from .mesh_backend import MeshConflictSet
+
+        return MeshConflictSet(**kwargs)
     raise ValueError(f"unknown conflict-set backend {backend!r}")

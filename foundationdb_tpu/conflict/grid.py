@@ -832,7 +832,14 @@ def reshard_host(
     keys = codes_to_bytes(codes)
 
     piv = np.asarray(new_pivot_codes, dtype=np.uint32).reshape(-1, L)
-    assert not piv[0].any(), "pivot 0 must be the empty key"
+    # pivot 0 must EQUAL the smallest live boundary (the state's lower
+    # bound by the slot-0 invariant: the zero code for a full-range grid,
+    # the partition's lower bound for a mesh shard) — a pivot below it
+    # would make searchsorted-1 yield -1 and inherit a garbage version
+    # from the last row
+    assert tuple(piv[0].tolist()) == tuple(codes[0].tolist()), (
+        "pivot 0 must equal the smallest live boundary"
+    )
     P = piv.shape[0]
     assert P <= n_buckets
     piv_keys = codes_to_bytes(piv)

@@ -1,0 +1,318 @@
+"""Multi-device ConflictSet: the sharded bucket-grid kernel behind the
+standard ConflictSet seam, so a cluster resolver transparently scales its
+MVCC conflict index across a TPU device mesh.
+
+The reference scales conflict resolution by recruiting more resolver
+PROCESSES, each owning a key-range partition (ResolutionRequestBuilder,
+MasterProxyServer.actor.cpp:233; rebalanced by masterserver.actor.cpp:896).
+On TPU the same partitioning maps onto a device mesh INSIDE one resolver
+role: each device owns a contiguous key-range shard of the grid
+(conflict/sharded.py), collectives make history verdicts and the
+intra-batch overlap matrix global before the commit fixpoint, and verdicts
+are bit-identical to a single-device resolver (tests/test_mesh_backend.py
+asserts this differentially).
+
+Overflow/rebalance discipline mirrors TpuConflictSet: every dispatch
+snapshots the stacked states; per-partition pressure from the kernel
+drives `sharded.reshard_partition` (the in-cluster analog of the
+reference's ResolutionSplitRequest, fdbserver/Resolver.actor.cpp:279),
+with replay from the snapshot on overflow — callers never observe it.
+When a balanced rebalance cannot fit, every partition's grid grows
+(vmapped reshard_device) and the group replays.
+
+`new_conflict_set("tpu")` auto-upgrades to this backend when more than
+one JAX device is visible; `__graft_entry__.dryrun_multichip` drives the
+same class, so the driver's multi-chip validation exercises exactly the
+cluster's code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import grid as G
+from . import keys as K
+from . import sharded
+from .api import CommitTransaction, ConflictSet, Verdict
+from .tpu_backend import (
+    _INT32_REBASE_THRESHOLD,
+    _VERDICT_TABLE,
+    KeyReservoir,
+    _bucket,
+    _pick_pivots,
+    encode_transactions,
+)
+
+def _lex_gt(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Row-wise lexicographic a > b over uint32 lanes (host numpy).
+    codes_to_bytes void keys sort correctly (np.unique/searchsorted) but
+    numpy defines NO elementwise comparison ufunc for void dtypes, so
+    filtering needs this explicit lane loop."""
+    a = np.asarray(a)
+    b = np.broadcast_to(np.asarray(b), a.shape)
+    gt = np.zeros(len(a), bool)
+    eq = np.ones(len(a), bool)
+    for i in range(a.shape[1]):
+        gt |= eq & (a[:, i] > b[:, i])
+        eq &= a[:, i] == b[:, i]
+    return gt
+
+
+class MeshConflictSet(ConflictSet):
+    def __init__(
+        self,
+        key_width: int = K.DEFAULT_KEY_WIDTH,
+        capacity: int = 1 << 14,
+        mesh=None,
+        n_parts: int = None,
+    ):
+        super().__init__()
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self._jax = jax
+        self._width = key_width
+        self._lanes = K.lanes_for_width(key_width)
+        if mesh is None:
+            devs = jax.devices()
+            if n_parts is None:
+                n_parts = len(devs)
+            mesh = Mesh(
+                np.array(devs[:n_parts]).reshape(n_parts, 1),
+                axis_names=("part", "data"),
+            )
+        self.mesh = mesh
+        self._n_parts = mesh.shape["part"]
+        # per-partition grid: capacity splits across partitions
+        self._B = _bucket(max(8, capacity // 16 // self._n_parts))
+        self._S = 32
+        self._sharding = jax.tree.map(
+            lambda _: NamedSharding(mesh, P("part")),
+            G.GridState(0, 0, 0, 0, 0),
+        )
+        self._states = self._fresh_states()
+        self._step = sharded.build_sharded_resolver(mesh, lanes=self._lanes)
+        self._base = -1
+        self._base_epoch = 0
+        self._inflight: list[dict] = []
+        # reservoir of raw endpoint keys for sample-seeded pivot selection
+        # (a device rebalance can only split between LIVE boundaries; a
+        # batch flooding one gap with brand-new keys needs pivots from
+        # the sample — same escalation as the single-device backend)
+        self._sample = KeyReservoir()
+
+    def _fresh_states(self):
+        return self._jax.device_put(
+            sharded.make_sharded_states(
+                self._n_parts, self._B, self._S, self._lanes
+            ),
+            self._sharding,
+        )
+
+    # -- ConflictSet interface ------------------------------------------------
+
+    def clear(self, version: int) -> None:
+        self._flush()
+        self._states = self._fresh_states()
+        self._base = version - 1
+        self._base_epoch += 1
+        self.oldest_version = version
+
+    def detect_batch(self, transactions, now, new_oldest_version):
+        return self.detect_many([(transactions, now, new_oldest_version)])[0]
+
+    def detect_many(self, work):
+        if not work:
+            return []
+        self._maybe_rebase(max(now for _, now, _2 in work))
+        return self.detect_many_encoded(
+            [(self.encode(txs), now, old) for txs, now, old in work]
+        )
+
+    def prepare(self, now: int) -> None:
+        self._maybe_rebase(now)
+
+    def encode(self, transactions):
+        b = encode_transactions(
+            transactions, self._width, self._base, sample_cb=self._sample.add
+        )
+        return b, len(transactions), self._base_epoch
+
+    def detect_many_encoded(self, work):
+        return self.detect_many_encoded_async(work)()
+
+    def detect_many_encoded_async(self, work):
+        """Same pipelining contract as TpuConflictSet: dispatch without
+        waiting, collect later; inter-batch state dependency lives on the
+        mesh."""
+        if not work:
+            return lambda: []
+        items = []
+        for (b, n_real, epoch), now, new_oldest in work:
+            if epoch != self._base_epoch:
+                raise RuntimeError(
+                    "stale encoding: version base was rebased after encode()"
+                )
+            horizon = max(self.oldest_version, new_oldest)
+            item = (
+                b,
+                n_real,
+                np.int32(now - self._base),
+                np.int32(max(self.oldest_version - self._base, 0)),
+                np.int32(max(horizon - self._base, 0)),
+            )
+            self.oldest_version = horizon
+            items.append(item)
+        group = {"items": items, "done": None}
+        self._dispatch(group)
+        self._inflight.append(group)
+
+        def result(group=group):
+            return self._collect(group)
+
+        return result
+
+    # -- internals ------------------------------------------------------------
+
+    def _dispatch(self, group) -> None:
+        group["snapshot"] = self._jax.tree_util.tree_map(
+            lambda x: x + 0, self._states
+        )
+        outs = []
+        st = self._states
+        for batch, _n, now, old_pre, old_post in group["items"]:
+            st, verdicts, pressure = self._step(st, batch, now, old_pre, old_post)
+            outs.append((verdicts, pressure))
+            # start device→host copies now — _collect's device_get then
+            # pays no extra tunnel round trip (same prefetch discipline
+            # as the single-device backend)
+            for a in (verdicts, pressure):
+                copy_async = getattr(a, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+        self._states = st
+        group["outs"] = outs
+
+    def _collect(self, group):
+        if group["done"] is not None:
+            return group["done"]
+        while self._inflight and self._inflight[0] is not group:
+            self._collect(self._inflight[0])
+        assert self._inflight and self._inflight[0] is group
+        S2 = G.staging_slots(self._S)
+        for attempt in range(6):
+            pressures = self._jax.device_get([p for _v, p in group["outs"]])
+            worst = np.max(np.stack(pressures), axis=0)  # [n_parts, 2]
+            over = (worst[:, 0] > S2) | (worst[:, 1] > self._S)
+            if not over.any():
+                break
+            # overflow: rebalance the offending partitions from the
+            # pre-group snapshot, then replay this group and everything
+            # after it (verdicts are deterministic — invisible to callers).
+            # Attempt 0: on-device rebalance (live-set skew). Attempt 1+:
+            # host reshard with the key SAMPLE — a device rebalance can
+            # only split between live boundaries, which never converges
+            # when a batch floods one gap with brand-new keys. Attempt 3+
+            # also grows every partition's grid.
+            self._states = group["snapshot"]
+            if attempt >= 3:
+                self._grow()
+            for p in np.nonzero(over)[0]:
+                if attempt == 0:
+                    self._states, pr = sharded.reshard_partition(
+                        self._states, int(p), self._B, self._S
+                    )
+                    if pr <= self._S:
+                        continue
+                self._host_reshard_partition(int(p))
+            self._states = self._jax.device_put(self._states, self._sharding)
+            for g in self._inflight:
+                self._dispatch(g)
+        else:
+            raise RuntimeError("mesh conflict grid reshard did not converge")
+
+        table = _VERDICT_TABLE
+        done = []
+        for (verdicts, _p), (_b, n_real, _now, _op, _opost) in zip(
+            group["outs"], group["items"]
+        ):
+            out = np.asarray(self._jax.device_get(verdicts))
+            done.append([table[v] for v in out[:n_real].tolist()])
+        group["done"] = done
+        group.pop("snapshot", None)
+        group.pop("outs", None)
+        group.pop("items", None)
+        self._inflight.pop(0)
+        return done
+
+    def _host_reshard_partition(self, p: int) -> None:
+        """Rebuild partition p's grid under pivots drawn from its live
+        boundaries ∪ the key sample clipped to its range (the mesh analog
+        of TpuConflictSet._reshard_host_sampled). Grows every partition
+        when a balanced split cannot fit."""
+        tm = self._jax.tree_util.tree_map
+        while True:
+            shard = tm(lambda x: x[p], self._states)
+            codes, _vers = G.live_rows(shard)
+            lo = np.asarray(shard.pivots)[0]  # partition lower bound
+            cands = codes
+            if self._sample:
+                samp = K.encode_keys(self._sample.keys, self._width)
+                cands = np.concatenate([cands, samp])
+            keys = G.codes_to_bytes(np.ascontiguousarray(cands))
+            _, uniq = np.unique(keys, return_index=True)
+            cands = cands[uniq]
+            # keep only candidates strictly above the partition's lower
+            # bound and (when not the last partition) below its upper
+            # bound — live rows of OTHER partitions never appear here,
+            # but sampled keys can
+            keep = _lex_gt(cands, lo)
+            if p + 1 < self._n_parts:
+                hi = np.asarray(self._states.pivots)[p + 1][0]
+                keep &= _lex_gt(np.broadcast_to(hi, cands.shape), cands)
+            cands = cands[keep]
+            pivots = _pick_pivots(cands, self._B, self._lanes, lo=lo)
+            try:
+                new_shard = G.reshard_host(shard, pivots, self._B, self._S)
+            except OverflowError:
+                self._grow()
+                continue
+            self._states = tm(
+                lambda full, s: full.at[p].set(s), self._states, new_shard
+            )
+            return
+
+    def _grow(self) -> None:
+        """Double every partition's bucket count (vmapped on-device
+        reshard folds floors and rebalances each shard)."""
+        self._B *= 2
+        grown, _pr = self._jax.vmap(
+            functools.partial(
+                G.reshard_device.__wrapped__,
+                n_buckets=self._B,
+                n_slots=self._S,
+            )
+        )(self._states)
+        self._states = self._jax.device_put(grown, self._sharding)
+
+    def _flush(self) -> None:
+        while self._inflight:
+            self._collect(self._inflight[0])
+
+    def _maybe_rebase(self, now: int) -> None:
+        if now - self._base < _INT32_REBASE_THRESHOLD:
+            return
+        self._flush()
+        new_base = self.oldest_version - 1
+        delta = new_base - self._base
+        if delta > 0:
+            self._states = self._jax.device_put(
+                self._jax.vmap(G.rebase.__wrapped__, in_axes=(0, None))(
+                    self._states, np.int32(delta)
+                ),
+                self._sharding,
+            )
+            self._base = new_base
+            self._base_epoch += 1

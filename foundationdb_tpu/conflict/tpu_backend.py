@@ -39,20 +39,108 @@ def _bucket(n: int, floor: int = 1) -> int:
     return b
 
 
-def _pick_pivots(cands: np.ndarray, n_buckets: int, lanes: int) -> np.ndarray:
+class KeyReservoir:
+    """Bounded reservoir of raw endpoint keys feeding sample-seeded pivot
+    selection — shared by the single-device and mesh backends."""
+
+    __slots__ = ("keys", "_skip")
+
+    def __init__(self):
+        self.keys: list[bytes] = []
+        self._skip = 0
+
+    def add(self, key: bytes) -> None:
+        self._skip += 1
+        if len(self.keys) < _SAMPLE_CAP:
+            self.keys.append(key)
+        elif self._skip % 17 == 0:
+            self.keys[self._skip % _SAMPLE_CAP] = key
+
+    def __bool__(self) -> bool:
+        return bool(self.keys)
+
+
+def encode_transactions(
+    transactions, width: int, base: int, sample_cb=None
+) -> G.Batch:
+    """Encode a commit batch into the kernel's padded Batch (host numpy).
+    Shared by the single-device and mesh backends."""
+    n = max(len(transactions), 1)
+    # pad T to a coarse grid: powers of two up to 512, then multiples
+    # of 512 — a 2500-txn batch costs 2560 rows of work, not 4096
+    # (every kernel phase scales with T; the compile cache still only
+    # sees a handful of shapes)
+    T = _bucket(n, 8) if n <= 512 else ((n + 511) // 512) * 512
+    KR = _bucket(
+        max((len(t.read_conflict_ranges) for t in transactions), default=0)
+        or 1
+    )
+    KW = _bucket(
+        max((len(t.write_conflict_ranges) for t in transactions), default=0)
+        or 1
+    )
+    sent = K.max_sentinel(width)
+    rb = np.tile(sent, (T, KR, 1))
+    re = np.tile(sent, (T, KR, 1))
+    wb = np.tile(sent, (T, KW, 1))
+    we = np.tile(sent, (T, KW, 1))
+    t_snap = np.zeros(T, np.int32)
+    t_has_reads = np.zeros(T, bool)
+
+    r_begins, r_ends, w_begins, w_ends = [], [], [], []
+    r_pos, w_pos = [], []
+    for t, tr in enumerate(transactions):
+        t_snap[t] = max(tr.read_snapshot - base, 0)
+        t_has_reads[t] = bool(tr.read_conflict_ranges)
+        for i, (b, e) in enumerate(tr.read_conflict_ranges):
+            r_begins.append(b)
+            r_ends.append(e)
+            r_pos.append((t, i))
+        for i, (b, e) in enumerate(tr.write_conflict_ranges):
+            w_begins.append(b)
+            w_ends.append(e)
+            w_pos.append((t, i))
+            if sample_cb is not None:
+                sample_cb(b)
+                sample_cb(e)
+
+    if r_begins:
+        cb = K.encode_keys(r_begins, width, round_up=False)
+        ce = K.encode_keys(r_ends, width, round_up=True)
+        for (t, i), eb, ee in zip(r_pos, cb, ce):
+            rb[t, i] = eb
+            re[t, i] = ee
+    if w_begins:
+        cb = K.encode_keys(w_begins, width, round_up=False)
+        ce = K.encode_keys(w_ends, width, round_up=True)
+        for (t, i), eb, ee in zip(w_pos, cb, ce):
+            wb[t, i] = eb
+            we[t, i] = ee
+
+    return G.Batch(
+        rb=rb, re=re, wb=wb, we=we, t_snap=t_snap, t_has_reads=t_has_reads
+    )
+
+
+def _pick_pivots(
+    cands: np.ndarray, n_buckets: int, lanes: int, lo: np.ndarray = None
+) -> np.ndarray:
     """≤ n_buckets-1 quantile pivots from sorted unique candidate codes
-    (uint32[N, lanes], none equal to the zero code); bucket 0 always
-    starts at the empty key."""
-    zero = np.zeros((1, lanes), dtype=np.uint32)
+    (uint32[N, lanes], all strictly above ``lo``); bucket 0 always starts
+    at ``lo`` (the empty key for a full-range grid, the partition's lower
+    bound for a mesh shard)."""
+    if lo is None:
+        lo = np.zeros((1, lanes), dtype=np.uint32)
+    lo = np.asarray(lo, dtype=np.uint32).reshape(1, lanes)
     n_piv = min(n_buckets - 1, len(cands))
     if n_piv <= 0:
-        return zero
+        return lo
     step = len(cands) / (n_piv + 1)
     idx = np.minimum(
         (np.arange(1, n_piv + 1) * step).astype(np.int64), len(cands) - 1
     )
     idx = np.unique(idx)
-    return np.concatenate([zero, cands[idx]], axis=0)
+    return np.concatenate([lo, cands[idx]], axis=0)
 
 
 class TpuConflictSet(ConflictSet):
@@ -72,8 +160,7 @@ class TpuConflictSet(ConflictSet):
         self._base = -1  # device versions are (version - base); 0 = never
         self._base_epoch = 0
         # reservoir of raw endpoint keys for pivot selection
-        self._sample: list[bytes] = []
-        self._sample_skip = 0
+        self._sample = KeyReservoir()
         self._resharded_once = False
         self._rebalance_wanted = False
         # dispatched-but-uncollected groups, in dispatch order
@@ -264,59 +351,8 @@ class TpuConflictSet(ConflictSet):
     # -- internals ------------------------------------------------------------
 
     def _encode(self, transactions) -> G.Batch:
-        n = max(len(transactions), 1)
-        # pad T to a coarse grid: powers of two up to 512, then multiples
-        # of 512 — a 2500-txn batch costs 2560 rows of work, not 4096
-        # (every kernel phase scales with T; the compile cache still only
-        # sees a handful of shapes)
-        T = _bucket(n, 8) if n <= 512 else ((n + 511) // 512) * 512
-        KR = _bucket(
-            max((len(t.read_conflict_ranges) for t in transactions), default=0)
-            or 1
-        )
-        KW = _bucket(
-            max((len(t.write_conflict_ranges) for t in transactions), default=0)
-            or 1
-        )
-        sent = K.max_sentinel(self._width)
-        rb = np.tile(sent, (T, KR, 1))
-        re = np.tile(sent, (T, KR, 1))
-        wb = np.tile(sent, (T, KW, 1))
-        we = np.tile(sent, (T, KW, 1))
-        t_snap = np.zeros(T, np.int32)
-        t_has_reads = np.zeros(T, bool)
-
-        r_begins, r_ends, w_begins, w_ends = [], [], [], []
-        r_pos, w_pos = [], []
-        for t, tr in enumerate(transactions):
-            t_snap[t] = max(tr.read_snapshot - self._base, 0)
-            t_has_reads[t] = bool(tr.read_conflict_ranges)
-            for i, (b, e) in enumerate(tr.read_conflict_ranges):
-                r_begins.append(b)
-                r_ends.append(e)
-                r_pos.append((t, i))
-            for i, (b, e) in enumerate(tr.write_conflict_ranges):
-                w_begins.append(b)
-                w_ends.append(e)
-                w_pos.append((t, i))
-                self._sample_key(b)
-                self._sample_key(e)
-
-        if r_begins:
-            cb = K.encode_keys(r_begins, self._width, round_up=False)
-            ce = K.encode_keys(r_ends, self._width, round_up=True)
-            for (t, i), eb, ee in zip(r_pos, cb, ce):
-                rb[t, i] = eb
-                re[t, i] = ee
-        if w_begins:
-            cb = K.encode_keys(w_begins, self._width, round_up=False)
-            ce = K.encode_keys(w_ends, self._width, round_up=True)
-            for (t, i), eb, ee in zip(w_pos, cb, ce):
-                wb[t, i] = eb
-                we[t, i] = ee
-
-        return G.Batch(
-            rb=rb, re=re, wb=wb, we=we, t_snap=t_snap, t_has_reads=t_has_reads
+        return encode_transactions(
+            transactions, self._width, self._base, sample_cb=self._sample.add
         )
 
     def _stack(self, batches: list[G.Batch]) -> G.Batch:
@@ -353,13 +389,6 @@ class TpuConflictSet(ConflictSet):
         # dispatch inside the jit call (a ~46 ms/group synchronous upload
         # over the tunnel otherwise)
         return jax.tree_util.tree_map(jax.device_put, stacked)
-
-    def _sample_key(self, key: bytes) -> None:
-        self._sample_skip += 1
-        if len(self._sample) < _SAMPLE_CAP:
-            self._sample.append(key)
-        elif self._sample_skip % 17 == 0:
-            self._sample[self._sample_skip % _SAMPLE_CAP] = key
 
     def _reshard(
         self,
@@ -398,7 +427,7 @@ class TpuConflictSet(ConflictSet):
         codes, _vers = G.live_rows(state)
         if self._sample:
             codes = np.concatenate(
-                [codes, K.encode_keys(self._sample, self._width)]
+                [codes, K.encode_keys(self._sample.keys, self._width)]
             )
         keys = G.codes_to_bytes(np.ascontiguousarray(codes))
         _, uniq_idx = np.unique(keys, return_index=True)

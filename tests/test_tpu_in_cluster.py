@@ -1,5 +1,5 @@
 """The TPU conflict backend running INSIDE the database (CPU twin under
-sim): resolvers built with conflict_backend="tpu" resolve real commit
+sim): resolvers built with conflict_backend="tpu1" resolve real commit
 batches through the proxy pipeline, pipelined via the encoded/async path,
 with verdict behavior identical to the oracle-backed cluster — including
 across a recovery (fresh ConflictSet at the recovery version)."""
@@ -17,7 +17,7 @@ from foundationdb_tpu.server.cluster import DynamicCluster
 def make_db(seed=0, **cfg):
     sim = Sim(seed=seed)
     sim.activate()
-    cluster = Cluster(sim, ClusterConfig(conflict_backend="tpu", **cfg))
+    cluster = Cluster(sim, ClusterConfig(conflict_backend="tpu1", **cfg))
     db = Database(sim, cluster.proxy_addrs)
     return sim, cluster, db
 
@@ -102,7 +102,7 @@ def test_tpu_backend_survives_recovery():
     sim.activate()
     cluster = DynamicCluster(
         sim,
-        ClusterConfig(n_storage=2, n_resolvers=2, conflict_backend="tpu"),
+        ClusterConfig(n_storage=2, n_resolvers=2, conflict_backend="tpu1"),
         n_coordinators=3,
     )
     db = Database.from_coordinators(sim, cluster.coordinators)
@@ -158,7 +158,7 @@ def test_resolver_backend_failure_does_not_wedge():
     sim = Sim(seed=77)
     sim.activate()
     p = sim.new_process("res", "res")
-    r = Resolver(backend="tpu", first_version=0, uid="r0")
+    r = Resolver(backend="tpu1", first_version=0, uid="r0")
     r.register_instance(p)
 
     def req(prev, version):
