@@ -132,9 +132,18 @@ def bench_e2e():
     n_clients = int(os.environ.get("BENCH_E2E_CLIENTS", "50"))
     n_txns = int(os.environ.get("BENCH_E2E_TXNS", "40"))
     keyspace = int(os.environ.get("BENCH_E2E_KEYSPACE", "100000"))
+    net = os.environ.get("BENCH_E2E_NET", "datacenter")
 
     sim = Sim(seed=0)
     sim.activate()
+    if net == "datacenter":
+        # the reference's commit-latency budget (performance.rst:36,
+        # 1.5-2.5 ms) is measured on REAL clusters with ~0.1-0.25 ms
+        # network hops; Sim2's default latency model averages 0.5 ms/hop
+        # (flow/Knobs.cpp:106). For the perf-budget comparison, model the
+        # benchmark network; BENCH_E2E_NET=sim2 keeps the fat sim profile.
+        sim.knobs.SIM_FAST_LATENCY = 0.00025
+        sim.knobs.SIM_MAX_LATENCY = 0.001
     cluster = Cluster(
         sim, ClusterConfig(n_proxies=2, n_resolvers=2, conflict_backend=backend)
     )
@@ -167,6 +176,12 @@ def bench_e2e():
     t0 = time.time()
     oks = sim.run_until_done(spawn(go()), 3600.0)
     wall = time.time() - t0
+    for pr in cluster.proxies:
+        snap = pr.stats.snapshot()
+        log(
+            f"  proxy {pr.uid}: p1Version {snap['phase1Version']} "
+            f"p2Resolve {snap['phase2Resolve']} p4Push {snap['phase4LogPush']}"
+        )
     assert all(oks)
     total = committed[0]
     assert total == len(latencies)
@@ -175,8 +190,8 @@ def bench_e2e():
     p95 = latencies[int(len(latencies) * 0.95)] * 1000
     tps = total / wall
     log(
-        f"e2e[{backend}]: {total} txns in {wall:.2f}s wall = {tps:.0f} txn/s; "
-        f"commit latency p50 {p50:.2f}ms p95 {p95:.2f}ms (sim time)"
+        f"e2e[{backend},{net}]: {total} txns in {wall:.2f}s wall = {tps:.0f} "
+        f"txn/s; commit latency p50 {p50:.2f}ms p95 {p95:.2f}ms (sim time)"
     )
     print(
         json.dumps(
@@ -188,6 +203,7 @@ def bench_e2e():
                 "p50_commit_ms_simtime": round(p50, 2),
                 "p95_commit_ms_simtime": round(p95, 2),
                 "backend": backend,
+                "net_profile": net,
             }
         )
     )

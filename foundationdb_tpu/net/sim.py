@@ -147,10 +147,17 @@ class Sim:
     def _latency(self) -> float:
         if buggify():
             return self.knobs.SIM_MAX_LATENCY * 10  # network hiccup
+        # Sim2's networkLatency shape (sim2.actor.cpp:1618, Knobs.cpp:106):
+        # almost always MIN + FAST·a (~0.5 ms average), with a rare long
+        # tail up to SIM_MAX_LATENCY — not uniform; a uniform draw put the
+        # AVERAGE hop at (MIN+MAX)/2 and tripled the commit budget
         k = self.knobs
-        return k.SIM_MIN_LATENCY + self.loop.random.random01() * (
-            k.SIM_MAX_LATENCY - k.SIM_MIN_LATENCY
-        )
+        a = self.loop.random.random01()
+        p_fast = 0.999
+        if a <= p_fast:
+            return k.SIM_MIN_LATENCY + k.SIM_FAST_LATENCY / p_fast * a
+        a = (a - p_fast) / (1 - p_fast)
+        return k.SIM_MIN_LATENCY + k.SIM_MAX_LATENCY * a
 
     def _deliverable(self, src: str, dst: str) -> bool:
         return (src, dst) not in self._partitioned and (

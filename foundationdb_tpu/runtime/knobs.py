@@ -9,8 +9,10 @@ from __future__ import annotations
 
 
 class Knobs:
-    # commit pipeline
-    COMMIT_BATCH_INTERVAL = 0.002  # proxy batch window (s)
+    # commit pipeline (reference: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+    # 1 ms / _FROM_IDLE 0.5 ms, fdbserver/Knobs.cpp:221-223)
+    COMMIT_BATCH_INTERVAL = 0.001  # proxy batch window (s)
+    COMMIT_BATCH_INTERVAL_FROM_IDLE = 0.0005  # first batch after idle
     MAX_COMMIT_BATCH_INTERVAL = 0.25  # idle proxies commit empty batches
     MAX_BATCH_TXNS = 4096
     VERSIONS_PER_SECOND = 1_000_000
@@ -33,10 +35,12 @@ class Knobs:
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
     # client
-    GRV_BATCH_INTERVAL = 0.001
+    GRV_BATCH_INTERVAL = 0.0005
     CLIENT_MAX_RETRY_DELAY = 1.0
-    # simulation
+    # simulation (Sim2's latency model: MIN + FAST·a almost always, rare
+    # tail to MAX — flow/Knobs.cpp:106-108, sim2.actor.cpp:1618)
     SIM_MIN_LATENCY = 0.0001
+    SIM_FAST_LATENCY = 0.0008
     SIM_MAX_LATENCY = 0.003
     SIM_CLOG_MAX = 2.0
 

@@ -119,9 +119,11 @@ class Cluster:
             self.resolvers.append(r)
             resolver_map.insert(rbounds[i], rbounds[i + 1], ResolverInterface(addr))
 
-        # proxies
+        # proxies (full peer list so GRVs confirm against every proxy's
+        # raw committed version instead of a master round trip)
         self.proxies: list[Proxy] = []
         self.proxy_addrs: list[str] = []
+        peer_list = [(f"proxy{i}", f"p{i}") for i in range(cfg.n_proxies)]
         for i in range(cfg.n_proxies):
             pr = Proxy(
                 master=MasterInterface("master"),
@@ -129,6 +131,8 @@ class Cluster:
                 log_system=LogSystem(tlog_set),
                 shards=shards,
                 knobs=self.knobs,
+                uid=f"p{i}",
+                peers=peer_list,
             )
             addr = f"proxy{i}"
             pr.register(sim.new_process(addr))

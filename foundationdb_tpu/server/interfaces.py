@@ -40,6 +40,12 @@ class TransactionData:
 @dataclass
 class GetCommitVersionRequest:
     requesting_proxy: str = ""
+    # per-proxy sequence (the reference's requestNum,
+    # fdbserver/MasterInterface.h GetCommitVersionRequest): lets a proxy
+    # keep several version requests in flight while the master assigns
+    # versions in submission order despite network reordering. -1 =
+    # unordered legacy caller (assign on arrival).
+    request_num: int = -1
 
 
 @dataclass

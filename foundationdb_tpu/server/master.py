@@ -74,6 +74,13 @@ class Master:
         self.last_assigned = first_version
         self.last_assigned_at = 0.0
         self.live_committed = first_version
+        # per-proxy requestNum sequencing (masterserver.actor.cpp:316
+        # getVersion): a proxy pipelines several version requests; the
+        # network may reorder them, but versions must be assigned in
+        # submission order or the proxy's batch-order/version-order
+        # invariant (phase 3) breaks
+        self._req_seq: dict[str, int] = {}
+        self._parked: dict[tuple, object] = {}  # (proxy, num) → Future
 
     # -- handlers --------------------------------------------------------------
 
@@ -82,12 +89,44 @@ class Master:
     ) -> GetCommitVersionReply:
         if buggify():
             await delay(0.001)  # slow version assignment (phase-1 stall)
+        if req.request_num >= 0:
+            from ..runtime.futures import Future, timeout as _timeout
+
+            expected = self._req_seq.get(req.requesting_proxy, 0)
+            if req.request_num < expected:
+                # a predecessor was skipped after its request was lost
+                # (partition drops requests on the floor); assigning now
+                # would violate the proxy's version-order invariant
+                raise RuntimeError(
+                    f"stale version request {req.request_num} < {expected}"
+                )
+            if req.request_num != expected:
+                # arrived early: park until predecessors are assigned —
+                # bounded, because a partition may have dropped a
+                # predecessor outright; on expiry, abandon the gap (the
+                # proxy's batch for the lost request fails on its own)
+                gate: Future = Future()
+                key = (req.requesting_proxy, req.request_num)
+                self._parked[key] = gate
+                fired = await _timeout(gate, 4.0)
+                self._parked.pop(key, None)
+                if fired is None and self._req_seq.get(
+                    req.requesting_proxy, 0
+                ) > req.request_num:
+                    raise RuntimeError("superseded while parked")
+            self._req_seq[req.requesting_proxy] = req.request_num + 1
         prev = self.last_assigned
         t = now()
         advance = int((t - self.last_assigned_at) * VERSIONS_PER_SECOND)
         advance = max(1, min(advance, MAX_VERSION_JUMP))
         self.last_assigned = prev + advance
         self.last_assigned_at = t
+        if req.request_num >= 0:
+            nxt = self._parked.pop(
+                (req.requesting_proxy, req.request_num + 1), None
+            )
+            if nxt is not None:
+                nxt._set(True)  # truthy: distinguishes wake from timeout
         return GetCommitVersionReply(prev_version=prev, version=self.last_assigned)
 
     async def report_committed(self, req: ReportRawCommittedVersionRequest):
@@ -325,8 +364,15 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
 
     proxy_workers = picker.pick("proxy", n_proxies)
     proxy_ifaces = []
+    # full peer list up front: every proxy confirms GRVs against every
+    # other proxy's raw committed version (getLiveCommittedVersion,
+    # MasterProxyServer.actor.cpp:875-885)
+    peer_list = [
+        (w.address, f"proxy-{recovery_count}-{i}-{uid}")
+        for i, w in enumerate(proxy_workers)
+    ]
     for i, w in enumerate(proxy_workers):
-        p_uid = f"proxy-{recovery_count}-{i}-{uid}"
+        p_uid = peer_list[i][1]
         await process.request(
             Endpoint(w.address, Tokens.WORKER_RECRUIT),
             RecruitRoleRequest(
@@ -340,6 +386,7 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                     epoch=recovery_count,
                     recovery_version=recovery_version,
                     log_ranges=log_ranges,
+                    peers=peer_list,
                 ),
             ),
         )

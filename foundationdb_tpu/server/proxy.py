@@ -36,13 +36,14 @@ from ..conflict.api import Verdict
 from ..errors import NotCommitted, TransactionTooOld
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
-from ..net.sim import BrokenPromise
+from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import (
     AsyncTrigger,
     Future,
     RequestBatcher,
     VersionGate,
     delay,
+    timeout,
     wait_for_all,
     wait_for_any,
 )
@@ -142,6 +143,16 @@ class ProxyDead(Exception):
     """This proxy's epoch ended (its tlogs are locked)."""
 
 
+async def _swallow(fut):
+    """Await a fire-and-forget request, discarding any error (the async
+    master report: a dead master only matters to recovery, not to this
+    commit, which is already durable)."""
+    try:
+        await fut
+    except Exception:
+        pass
+
+
 class Proxy:
     def __init__(
         self,
@@ -154,6 +165,7 @@ class Proxy:
         recovery_version: Version = 0,
         uid: str = "",
         log_ranges: dict = None,  # uid → {begin, end, dest}: active captures
+        peers: list = None,  # [(address, uid)] of ALL the epoch's proxies
     ):
         self.master = master
         self.resolver_map = resolver_map
@@ -162,6 +174,7 @@ class Proxy:
             shards = shards.to_list()
         self.shards = ShardMap.from_list(shards)  # own copy: mutated by echoes
         self.log_ranges = dict(log_ranges or {})
+        self.peers = [p for p in (peers or []) if p[1] != uid]
         self.knobs = knobs or Knobs()
         self.epoch = epoch
         self.uid = uid
@@ -177,6 +190,7 @@ class Proxy:
         # (the latestLocalCommitBatchResolving/Logging gates, :353,415);
         # everything between pipelines freely
         self._local_batch = 0
+        self._gcv_num = 0  # requestNum sequence for pipelined version asks
         self._resolving_gate = VersionGate(0)
         self._logging_gate = VersionGate(0)
         # ratekeeper gate state (None until a getRate reply arrives)
@@ -199,6 +213,10 @@ class Proxy:
         self._c_mutation_bytes = self.stats.counter("mutationBytes")
         self._l_commit = self.stats.latency("commitLatency")
         self._l_grv = self.stats.latency("grvLatency")
+        # per-phase sim-time samples (batch-cut → reply), for latency work
+        self._l_p1 = self.stats.latency("phase1Version")
+        self._l_p2 = self.stats.latency("phase2Resolve")
+        self._l_p4 = self.stats.latency("phase4LogPush")
 
     # -- GRV -------------------------------------------------------------------
 
@@ -228,10 +246,57 @@ class Proxy:
         return GetReadVersionReply(version=version)
 
     async def _fetch_live_version(self):
-        live = await self.process.request(
-            self.master.ep("getLiveCommitted"), None
+        """getLiveCommittedVersion (MasterProxyServer.actor.cpp:875):
+        max over every proxy's raw committed version — peer confirmation
+        is what lets phase 5 reply WITHOUT awaiting a master round trip
+        (causality: an acked commit at V raised its proxy's
+        committed_version to ≥ V before the ack, and this GRV started
+        after the ack, so that peer answers ≥ V). A dead peer never
+        lowers the answer — we keep asking until it answers or this
+        epoch dies (brokenPromiseToNever, :885)."""
+        if not self.peers:
+            live = await self.process.request(
+                self.master.ep("getLiveCommitted"), None
+            )
+            return max(live.version, self.committed_version)
+
+        async def peer_version(address, uid):
+            # bounded: a peer that stays unreachable for several failure
+            # timeouts means this epoch is ending — error the GRV so the
+            # client retries against the NEXT epoch's proxies (an unbounded
+            # wait here outlived the role: destroy cancels the batcher
+            # whose push failure would otherwise mark this proxy dead).
+            # Each attempt is itself timed out: a PARTITIONED network drops
+            # the request on the floor (net/sim.py) and the reply future
+            # would otherwise never resolve at all.
+            deadline = self.knobs.FAILURE_TIMEOUT * 3
+            waited = 0.0
+            while True:
+                self._check_alive()
+                try:
+                    r = await timeout(
+                        self.process.request(
+                            Endpoint(address, f"proxy.rawCommitted#{uid}"),
+                            None,
+                        ),
+                        1.0,
+                    )
+                    if r is not None:
+                        return r
+                except BrokenPromise:
+                    pass
+                if waited >= deadline:
+                    raise BrokenPromise(f"proxy peer {uid} unreachable")
+                await delay(0.05)
+                waited += 1.05
+
+        votes = await wait_for_all(
+            [
+                self.process.spawn(peer_version(a, u))
+                for a, u in self.peers
+            ]
         )
-        return live.version
+        return max([self.committed_version, *votes])
 
     async def rate_poller(self):
         """Poll the master's ratekeeper (getRate:85); no ratekeeper (the
@@ -294,6 +359,7 @@ class Proxy:
 
     async def batcher_loop(self):
         while True:
+            from_idle = False
             if not self._batch:
                 self._work = Future()
                 # an idle proxy still commits an EMPTY batch periodically:
@@ -307,24 +373,48 @@ class Proxy:
                 if which == 1 and not self._batch:
                     self.process.spawn(self.commit_batch([]))
                     continue
+                from_idle = True
             # batch window: flush on interval or on the size trigger (which
-            # may already have fired while we were parked on _work)
+            # may already have fired while we were parked on _work). A batch
+            # opened from idle cuts sooner (the reference's
+            # COMMIT_TRANSACTION_BATCH_INTERVAL_FROM_IDLE, Knobs.cpp:221) —
+            # a lone transaction must not wait the full window
             if buggify():
                 pass  # cut the batch immediately: tiny one-txn batches
             elif len(self._batch) < self.knobs.MAX_BATCH_TXNS:
+                interval = (
+                    self.knobs.COMMIT_BATCH_INTERVAL_FROM_IDLE
+                    if from_idle
+                    else self.knobs.COMMIT_BATCH_INTERVAL
+                )
                 trigger = self._batch_trigger = Future()
-                await wait_for_any([trigger, delay(self.knobs.COMMIT_BATCH_INTERVAL)])
+                await wait_for_any([trigger, delay(interval)])
             batch, self._batch = self._batch, []
             # commit batches run concurrently (pipelined); version chaining
-            # at resolvers/tlogs orders application
+            # at resolvers/tlogs orders application. The version request
+            # fires at coroutine start — commit_batch coroutines begin in
+            # spawn order, so requestNum order == local batch order
             self.process.spawn(self.commit_batch(batch))
+
+    def _fire_gcv(self):
+        """Fire one pipelined version request (requestNum keeps master-side
+        assignment in submission order despite network reordering) — with
+        one request at a time, a version RTT longer than the batch
+        interval built an unbounded phase-1 queue."""
+        num = self._gcv_num
+        self._gcv_num += 1
+        return self.process.request(
+            self.master.ep("getCommitVersion"),
+            GetCommitVersionRequest(requesting_proxy=self.uid, request_num=num),
+        )
 
     async def commit_batch(self, batch):
         replies = [f for _, f in batch]
         self._local_batch += 1
         local_n = self._local_batch
+        vfut = self._fire_gcv()
         try:
-            await self._commit_batch(batch, local_n)
+            await self._commit_batch(batch, local_n, vfut)
         except TLogStopped as e:
             # this epoch is over: a recovering master locked our tlogs
             self.failed = True
@@ -348,19 +438,18 @@ class Proxy:
             self._resolving_gate.advance_to(local_n)
             self._logging_gate.advance_to(local_n)
 
-    async def _commit_batch(self, batch, local_n):
+    async def _commit_batch(self, batch, local_n, vfut):
         txns = [t for t, _ in batch]
         replies = [f for _, f in batch]
 
         # phase 1 (ordered): version assignment + send resolve requests.
         # Ordering phase 1 per proxy makes this proxy's commit versions
-        # monotone in batch order, which phase 3 depends on.
+        # monotone in batch order, which phase 3 depends on. The version
+        # request itself was fired at batch spawn (pipelined).
+        t_p1 = now()
         await self._resolving_gate.wait_until(local_n - 1)
         try:
-            vreq = await self.process.request(
-                self.master.ep("getCommitVersion"),
-                GetCommitVersionRequest(requesting_proxy=self.uid),
-            )
+            vreq = await vfut
             prev_version, version = vreq.prev_version, vreq.version
             resolve_futs, resolve_meta = self._send_resolve(
                 prev_version, version, txns
@@ -369,9 +458,12 @@ class Proxy:
             # always release the chain — a failed batch must not wedge the
             # proxy; successors fail or succeed on their own
             self._resolving_gate.advance_to(local_n)
+        self._l_p1.add(now() - t_p1)
 
         # phase 2: await resolver verdicts
+        t_p2 = now()
         resolutions = await wait_for_all(resolve_futs)
+        self._l_p2.add(now() - t_p2)
         verdicts = [Verdict.COMMITTED] * len(txns)
         for idxs, reply in zip(resolve_meta, resolutions):
             for i, v in zip(idxs, reply.committed):
@@ -440,6 +532,7 @@ class Proxy:
         # the tlogs' own prev_version chaining, so pushes of successive
         # batches may be in flight simultaneously (the reference's
         # pipelining).
+        t_p4 = now()
         await self.log_system.push(
             self.process,
             prev_version,
@@ -447,15 +540,25 @@ class Proxy:
             to_log,
             known_committed=self.committed_version,
         )
+        self._l_p4.add(now() - t_p4)
 
-        # phase 5: make the commit visible, then reply. The awaited master
-        # report is what lets any proxy's GRV see this commit (causality).
+        # phase 5: make the commit visible locally, then reply — the
+        # master report is ASYNC (the reference replies straight after
+        # the log push, MasterProxyServer.actor.cpp:821-835; GRV
+        # causality comes from peer confirmation in _fetch_live_version,
+        # not from the master). With no peer set (static single-proxy
+        # harness), the report stays awaited so the master's GRV answer
+        # keeps causality.
         if version > self.committed_version:
             self.committed_version = version
-        await self.process.request(
+        report = self.process.request(
             self.master.ep("reportCommitted"),
             ReportRawCommittedVersionRequest(version=version),
         )
+        if self.peers:
+            self.process.spawn(_swallow(report))
+        else:
+            await report
         self._c_batches.add()
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
@@ -560,8 +663,20 @@ class Proxy:
         if self.failed:
             raise BrokenPromise(f"proxy {self.uid} epoch {self.epoch} is dead")
 
+    def close(self) -> None:
+        """Role retirement (worker._destroy): fail fast so parked GRVs
+        (peer-confirm loops) error out instead of outliving the role."""
+        self.failed = True
+        self._grv_replenished.trigger()
+
     async def _metrics(self, _req) -> dict:
         return self.stats.snapshot()
+
+    async def _raw_committed(self, _req) -> Version:
+        """getRawCommittedVersion (MasterProxyServer.actor.cpp:1214): the
+        peer-confirmation half of getLiveCommittedVersion."""
+        self._check_alive()
+        return self.committed_version
 
     def register(self, process) -> None:
         """Well-known tokens (static cluster)."""
@@ -570,6 +685,7 @@ class Proxy:
         process.register(Tokens.COMMIT, self.commit)
         process.register(Tokens.GET_KEY_SERVERS, self.get_key_servers)
         process.register(f"proxy.metrics#{self.uid}", self._metrics)
+        process.register(f"proxy.rawCommitted#{self.uid}", self._raw_committed)
         process.spawn(self.batcher_loop())
         process.spawn(self.stats.trace_loop(5.0, process.address))
 
@@ -581,6 +697,7 @@ class Proxy:
         process.register(f"{Tokens.GET_KEY_SERVERS}#{self.uid}", self.get_key_servers)
         process.register(f"proxy.ping#{self.uid}", self._ping)
         process.register(f"proxy.metrics#{self.uid}", self._metrics)
+        process.register(f"proxy.rawCommitted#{self.uid}", self._raw_committed)
 
     async def _ping(self, _req):
         self._check_alive()

@@ -37,7 +37,7 @@ from .interfaces import (
     Version,
 )
 
-FSYNC_TIME = 0.0005  # simulated DiskQueue sync
+FSYNC_TIME = 0.0002  # simulated DiskQueue sync (SSD-class fsync)
 
 
 class Spilled:

@@ -363,6 +363,7 @@ class Worker:
         epoch=0,
         recovery_version=0,
         log_ranges=None,
+        peers=None,
     ):
         from .proxy import Proxy
 
@@ -376,6 +377,7 @@ class Worker:
             recovery_version=recovery_version,
             uid=h.uid,
             log_ranges=log_ranges,
+            peers=peers,
         )
         h.epoch, h.obj = epoch, pr
         pr.register_instance(self.process)

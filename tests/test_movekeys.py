@@ -105,9 +105,16 @@ def test_move_shard_end_to_end():
             Tokens,
         )
 
-        reply = await db._proxy_request(
-            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=b"\x90")
-        )
+        # a proxy that didn't commit the final move txn applies its echo
+        # at its NEXT commit batch (bounded staleness ≤ the idle-commit
+        # interval) — poll until every proxy's map converges
+        for _ in range(20):
+            reply = await db._proxy_request(
+                Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=b"\x90")
+            )
+            if set(reply.tags) == {0, 1}:
+                break
+            await delay(0.1)
         assert set(reply.tags) == {0, 1}, reply
         # source storage no longer owns it
         src_ss = next(
