@@ -58,7 +58,14 @@ class KeyValueStoreMemory:
 
     def _apply_ops(self, r: BinaryReader, kind: int) -> None:
         if kind == _OP_SET:
-            self._map[r.bytes_()] = r.bytes_()
+            # locals first: Python evaluates an assignment's RHS before
+            # the subscript target, so inlining both reads SWAPPED
+            # key/value on op-log replay (a reboot then served rows whose
+            # key was the old value — found by the chaos soak's
+            # ConsistencyCheck as replica divergence)
+            k = r.bytes_()
+            v = r.bytes_()
+            self._map[k] = v
         elif kind == _OP_CLEAR:
             b, e = r.bytes_(), r.bytes_()
             for k in [k for k in self._map if b <= k < e]:

@@ -108,6 +108,11 @@ class Sim:
         self.disks: dict[str, Any] = {}  # machine → SimDisk (survives reboot)
         self._clogged_until: dict[tuple[str, str], float] = {}
         self._partitioned: set[tuple[str, str]] = set()
+        # simulation-only durability oracle (fdbrpc/sim_validation.h:38):
+        # acked commit versions vs recovery end versions
+        from ..runtime.validation import DurabilityOracle
+
+        self.validation = DurabilityOracle()
 
     def disk(self, machine: str):
         """The machine's persistent SimDisk (files survive kill/reboot)."""

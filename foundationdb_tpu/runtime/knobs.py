@@ -27,6 +27,12 @@ class Knobs:
     STORAGE_TPU_INDEX = False  # TPU batched-read snapshot index
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
+    # data distribution (DataDistributionTracker.actor.cpp knobs
+    # SHARD_MAX_BYTES_PER... scaled to sim data volumes)
+    DD_SHARD_MAX_BYTES = 1 << 18  # split above this
+    DD_SHARD_MIN_BYTES = 1 << 15  # merge adjacent same-team shards below
+    DD_TRACKER_INTERVAL = 2.0
+    DD_MOVE_THROTTLE = 0.5  # min delay between relocations (move queue)
     # failure detection / recovery
     HEARTBEAT_INTERVAL = 0.5
     FAILURE_TIMEOUT = 2.0

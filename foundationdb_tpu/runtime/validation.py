@@ -1,0 +1,36 @@
+"""Simulation-only invariant oracles — the analog of fdbrpc/sim_validation.h.
+
+The reference threads debug hooks through production code that are active
+only under simulation (debug_advanceMaxCommittedVersion /
+debug_checkMinCommittedVersion, sim_validation.h:38): every version ACKED
+to a client is recorded, and every recovery's chosen epoch-end version is
+checked against it — a recovery that picks an end version below an acked
+commit has silently lost durable data, which no workload read would
+reliably catch (the key may never be read again).
+
+Wired at the same points as the reference: the proxy's phase-5 ack
+(MasterProxyServer.actor.cpp:834 debug_advanceMinCommittedVersion) and the
+master's epoch-end determination (masterserver.actor.cpp recovery).
+"""
+
+from __future__ import annotations
+
+
+class DurabilityOracle:
+    def __init__(self):
+        self.max_acked = 0  # highest commit version acked to ANY client
+        self.violations: list[str] = []
+
+    def note_acked(self, version: int) -> None:
+        if version > self.max_acked:
+            self.max_acked = version
+
+    def check_recovery(self, end_version: int, epoch: int) -> None:
+        """A new epoch's end version must cover every acked commit."""
+        if end_version < self.max_acked:
+            msg = (
+                f"recovery epoch {epoch} chose end version {end_version} "
+                f"below acked commit {self.max_acked}: acked data LOST"
+            )
+            self.violations.append(msg)
+            raise AssertionError(msg)

@@ -28,7 +28,7 @@ from ..runtime.futures import delay, timeout
 from ..runtime.trace import SevInfo, SevWarn, trace
 from ..runtime.buggify import buggify
 from .interfaces import GetKeyServersRequest, Tokens
-from .movekeys import move_shard, take_move_keys_lock
+from .movekeys import merge_shards, move_shard, split_shard, take_move_keys_lock
 
 
 class DataDistributor:
@@ -52,6 +52,8 @@ class DataDistributor:
         # a successor DD overwrites it and our movers abort (movekeys.py)
         self.uid = uid or f"dd-{process.address}"
         self.alive: dict[int, bool] = {s.tag: True for s in storage}
+        self._last_move = -1e9  # relocation throttle (the move queue's
+        #                         pacing — DataDistributionQueue's limits)
         # (shard begin, tag) → consecutive rounds a live member reported
         # the shard unreadable (e.g. it rebooted and lost an in-flight
         # fetch whose sources are gone) — treated like a dead member
@@ -59,8 +61,10 @@ class DataDistributor:
 
     async def run(self):
         monitor = self.process.spawn(self._failure_monitor())
+        tracker = None
         try:
             await take_move_keys_lock(self.db, self.uid)
+            tracker = self.process.spawn(self._size_tracker())
             while True:
                 await delay(0.2 if buggify() else 1.0)  # eager repair races moves
                 try:
@@ -71,6 +75,8 @@ class DataDistributor:
                     )
         finally:
             monitor.cancel()  # dies with this DD, not with the process
+            if tracker is not None:
+                tracker.cancel()
 
     async def _failure_monitor(self):
         misses = {s.tag: 0 for s in self.storage}
@@ -125,16 +131,11 @@ class DataDistributor:
 
     async def _walk_shards(self):
         """[(begin, end, tags)] from the proxies' live keyInfo."""
-        out = []
-        key = b""
-        while True:
-            reply = await self.db._proxy_request(
-                Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
-            )
-            out.append((reply.begin, reply.end, tuple(reply.tags)))
-            if reply.end is None:
-                return out
-            key = reply.end
+        from .movekeys import walk_shards
+
+        return [
+            (b, e, tags) for b, e, _team, tags in await walk_shards(self.db)
+        ]
 
     async def _get_excluded(self) -> set:
         from ..client.management import EXCLUDED_PREFIX
@@ -149,6 +150,100 @@ class DataDistributor:
             return await self.db.run(body, max_retries=3)
         except Exception:
             return set()
+
+    async def _size_tracker(self):
+        """Shard size tracking + split/merge (DataDistributionTracker
+        .actor.cpp:829 trackShardBytes + shardSplitter:340 /
+        shardMerger:429): sampled byte estimates from a live member drive
+        metadata-only splits of large shards and merges of adjacent cold
+        same-team shards."""
+        while True:
+            await delay(self.knobs.DD_TRACKER_INTERVAL)
+            try:
+                await self._track_once()
+            except Exception as e:
+                trace(
+                    SevWarn, "DDTrackerError", self.process.address, Err=repr(e)
+                )
+
+    async def _shard_bytes(self, begin, end, tags, by_tag):
+        for t in tags:
+            if not self.alive.get(t, False) or t not in by_tag:
+                continue
+            try:
+                m = await timeout(
+                    self.process.request(
+                        Endpoint(by_tag[t].address, Tokens.GET_SHARD_METRICS),
+                        (begin, end),
+                    ),
+                    1.0,
+                )
+            except Exception:
+                continue
+            if m is not None:
+                return m["bytes"]
+        return None
+
+    async def _track_once(self):
+        shards = await self._walk_shards()
+        by_tag = {s.tag: s for s in self.storage}
+        sizes = []
+        for begin, end, tags in shards:
+            sizes.append(await self._shard_bytes(begin, end, tags, by_tag))
+        # split the largest oversized shard (one structural change per
+        # round keeps the tracker from racing its own boundary edits)
+        worst_i, worst = None, self.knobs.DD_SHARD_MAX_BYTES
+        for i, b in enumerate(sizes):
+            if b is not None and b > worst:
+                worst_i, worst = i, b
+        if worst_i is not None:
+            begin, end, tags = shards[worst_i]
+            at = None
+            for t in tags:
+                if not self.alive.get(t, False) or t not in by_tag:
+                    continue
+                try:
+                    at = await timeout(
+                        self.process.request(
+                            Endpoint(by_tag[t].address, Tokens.GET_SPLIT_KEY),
+                            (begin, end),
+                        ),
+                        1.0,
+                    )
+                except Exception:
+                    continue
+                break
+            if at:
+                trace(
+                    SevInfo,
+                    "DDShardSplit",
+                    self.process.address,
+                    Begin=begin,
+                    At=at,
+                    Bytes=worst,
+                )
+                await split_shard(self.db, at, lock_owner=self.uid)
+            return
+        # merge one adjacent cold pair with identical teams
+        for (b1, e1, t1), (b2, _e2, t2), s1, s2 in zip(
+            shards, shards[1:], sizes, sizes[1:]
+        ):
+            if (
+                e1 == b2
+                and set(t1) == set(t2)
+                and s1 is not None
+                and s2 is not None
+                and s1 + s2 < self.knobs.DD_SHARD_MIN_BYTES
+            ):
+                trace(
+                    SevInfo,
+                    "DDShardMerge",
+                    self.process.address,
+                    Begin=b1,
+                    Mid=b2,
+                )
+                await merge_shards(self.db, b1, lock_owner=self.uid)
+                return
 
     async def _repair_once(self):
         shards = await self._walk_shards()
@@ -202,6 +297,17 @@ class DataDistributor:
                     candidates = distinct + [
                         t for t in candidates if t not in distinct
                     ]
+            # alive-but-unready members can be rebuilt in place: a
+            # same-team re-move restarts their fetch from a healthy
+            # source (otherwise a wedged member with no replacement
+            # stays unreadable forever)
+            rebuildable = [
+                t
+                for t in dead
+                if self.alive.get(t, False) and t not in excluded_tags
+            ]
+            if need > len(candidates):
+                candidates += rebuildable
             if need > len(candidates):
                 trace(
                     SevWarn,
@@ -216,6 +322,14 @@ class DataDistributor:
             new_tags = (healthy + candidates[:need])[: self.replication]
             if not new_tags:
                 continue
+            # throttled move queue: repairs are paced so a burst of
+            # failures doesn't saturate the cluster with relocations
+            from ..runtime.loop import now as _now
+
+            gap = self.knobs.DD_MOVE_THROTTLE - (_now() - self._last_move)
+            if gap > 0:
+                await delay(gap)
+            self._last_move = _now()
             trace(
                 SevInfo,
                 "DDRelocating",
@@ -230,6 +344,7 @@ class DataDistributor:
                 end,
                 [by_tag[t] for t in new_tags],
                 lock_owner=self.uid,
+                rebuild_tags=tuple(t for t in rebuildable if t in new_tags),
             )
             for t in candidates[:need]:
                 load[t] += 1

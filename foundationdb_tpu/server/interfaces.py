@@ -399,6 +399,8 @@ class Tokens:
     GET_VALUE = "storage.getValue"
     GET_KEY_VALUES = "storage.getKeyValues"
     GET_SHARD_STATE = "storage.getShardState"
+    GET_SHARD_METRICS = "storage.getShardMetrics"
+    GET_SPLIT_KEY = "storage.getSplitKey"
     WATCH_VALUE = "storage.watchValue"
     BATCH_GET = "storage.batchGet"
     # worker

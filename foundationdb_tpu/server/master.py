@@ -217,6 +217,12 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         recovery_version = epoch_end_version(locks)
         known = max(r.known_committed for r in locks.values())
         assert recovery_version >= known, "epoch end below a committed version"
+        # sim-only durability oracle: the end version must cover every
+        # commit ever ACKED to a client (sim_validation.h:38
+        # debug_checkMinCommittedVersion analog)
+        oracle = getattr(getattr(process, "sim", None), "validation", None)
+        if oracle is not None:
+            oracle.check_recovery(recovery_version, recovery_count)
         old_sets = [o for o in prev.old_tlog_sets]
         old_sets.append(OldTLogSet(set=prev.tlog_set, end_version=recovery_version))
         trace(

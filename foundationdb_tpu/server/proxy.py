@@ -560,6 +560,14 @@ class Proxy:
         else:
             await report
         self._c_batches.add()
+        # sim-only durability oracle: record the acked version BEFORE any
+        # reply leaves (debug_advanceMinCommittedVersion,
+        # MasterProxyServer.actor.cpp:805)
+        oracle = getattr(getattr(self.process, "sim", None), "validation", None)
+        if oracle is not None and any(
+            v == Verdict.COMMITTED for v in verdicts
+        ):
+            oracle.note_acked(version)
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
                 self._c_txn_committed.add()

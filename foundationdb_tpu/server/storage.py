@@ -88,6 +88,11 @@ class StorageServer:
         self.owned = KeyRangeMap(default=None)
         for begin, end in owned_ranges or ():
             self.owned.insert(begin, end, ("owned", 0))
+            # the SEEDED ownership must be durable too: the first meta
+            # write otherwise records owned=[] and a reboot recovers the
+            # data rows with no ownership (permanently unreadable shard —
+            # found by the chaos soak via quiet_database)
+            self._persist_owned.insert(begin, end, ("owned", 0))
         # (begin, end) → [(mutation, version)] buffered during a fetch
         self._fetch_buffers: dict = {}
         # (begin, end) → (sources, move_version): enough to re-fetch if a
@@ -532,6 +537,7 @@ class StorageServer:
         self.data.latest_version = durable
         # the engine's shard assignment supersedes the manifest's seed list
         self.owned = KeyRangeMap(default=None)
+        self._persist_owned = KeyRangeMap(default=None)
         for b_hex, e_hex, state in meta["owned"]:
             begin = bytes.fromhex(b_hex)
             end = bytes.fromhex(e_hex) if e_hex is not None else None
@@ -686,6 +692,56 @@ class StorageServer:
                 return WatchValueReply(value=v, version=self.version.get())
             await self.version.on_change()
 
+    def _sampled_range(self, begin: bytes, end: bytes):
+        """(keys, stride): a stride-sampled slice of the engine's sorted
+        keys in [begin, end) — the byte-sampling analog
+        (storageserver.actor.cpp:2886 byteSampleApplySet): shard size
+        estimation must not scan every row."""
+        import bisect as _b
+
+        if self.engine is None or not hasattr(self.engine, "_keys"):
+            rows = dict(
+                self._read_range_merged(begin, end, self.version.get(), 5000, False)
+            )
+            return (
+                sorted(rows),
+                1,
+                (lambda k: len(k) + len(rows.get(k) or b"")),
+            )
+        ks = self.engine._keys
+        lo = _b.bisect_left(ks, begin)
+        hi = _b.bisect_left(ks, end)
+        n = hi - lo
+        stride = max(1, n // 64)
+        keys = ks[lo:hi:stride]
+        return keys, stride, (lambda k: len(k) + len(self.engine._map.get(k, b"")))
+
+    async def get_shard_metrics(self, req) -> dict:
+        """Estimated bytes/rows for [begin, end) — the DD tracker's
+        getShardMetrics source (DataDistributionTracker.actor.cpp:829)."""
+        begin, end = req
+        end = end if end is not None else b"\xff\xff"
+        keys, stride, size_of = self._sampled_range(begin, end)
+        est = sum(size_of(k) for k in keys) * stride
+        return {"bytes": est, "rows": len(keys) * stride}
+
+    async def get_split_key(self, req):
+        """A key splitting [begin, end) into roughly equal halves by
+        sampled bytes (splitStorageMetrics analog); None when the range
+        is too small to split."""
+        begin, end = req
+        end = end if end is not None else b"\xff\xff"
+        keys, _stride, size_of = self._sampled_range(begin, end)
+        if len(keys) < 4:
+            return None
+        total = sum(size_of(k) for k in keys)
+        acc = 0
+        for k in keys:
+            acc += size_of(k)
+            if acc * 2 >= total:
+                return k if begin < k < end else None
+        return None
+
     async def get_shard_state(self, req) -> bool:
         """Is [begin, end) fully owned and readable? (the mover's readiness
         poll before finishMoveKeys — getShardStateQ in the reference)."""
@@ -718,6 +774,8 @@ class StorageServer:
         process.register(f"storage.ping#{self.uid}", self._ping)
         process.register(f"storage.metrics#{self.uid}", self._metrics)
         process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
+        process.register(Tokens.GET_SHARD_METRICS, self.get_shard_metrics)
+        process.register(Tokens.GET_SPLIT_KEY, self.get_split_key)
         process.register(Tokens.WATCH_VALUE, self.watch_value)
         process.register(Tokens.BATCH_GET, self.batch_get)
         trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)

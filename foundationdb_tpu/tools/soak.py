@@ -19,10 +19,13 @@ from ..runtime.futures import spawn
 from ..runtime.knobs import Knobs
 from ..server.cluster import ClusterConfig, DynamicCluster
 from ..workloads import (
+    ApiCorrectnessWorkload,
     AttritionWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
     RandomCloggingWorkload,
+    RywFuzzWorkload,
+    SerializabilityWorkload,
     SidebandWorkload,
     run_workloads,
 )
@@ -67,7 +70,17 @@ def run_one(seed: int, verbose: bool = False) -> dict:
         CycleWorkload(db, rng.fork(), nodes=10, transactions=25),
         SidebandWorkload(db, rng.fork(), messages=25),
         RandomCloggingWorkload(db, rng.fork(), duration=4.0),
+        # the API-fuzz battery (oracle-checked) rotates in per seed
+        ApiCorrectnessWorkload(db, rng.fork(), transactions=15, client_id=0),
+        RywFuzzWorkload(db, rng.fork(), transactions=8, client_id=0),
     ]
+    if shape_rng.coinflip(0.5):
+        workloads += [
+            SerializabilityWorkload(
+                db, rng.fork(), transactions=10, client_id=i, client_count=2
+            )
+            for i in range(2)
+        ]
     if kills and cfg.replication > 1:
         workloads.append(
             AttritionWorkload(

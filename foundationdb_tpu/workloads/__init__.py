@@ -48,3 +48,6 @@ from .write_during_read import WriteDuringReadWorkload  # noqa: E402,F401
 from .clogging import RandomCloggingWorkload  # noqa: E402,F401
 from .attrition import AttritionWorkload  # noqa: E402,F401
 from .consistency_check import ConsistencyCheckWorkload  # noqa: E402,F401
+from .api_correctness import ApiCorrectnessWorkload  # noqa: E402,F401
+from .serializability import SerializabilityWorkload  # noqa: E402,F401
+from .ryw_fuzz import RywFuzzWorkload  # noqa: E402,F401

@@ -26,12 +26,17 @@ class ConsistencyCheckWorkload(Workload):
         self.replication = replication
 
     async def check(self) -> bool:
+        # drain in-flight relocations first (QuietDatabase.actor.cpp:1 —
+        # checkConsistency quiets the database before reading replicas)
+        from .quiet import quiet_database
+
+        await quiet_database(self.db)
         for attempt in range(30):
             try:
                 return await self._check_once()
             except (BrokenPromise, FdbError):
-                # mid-recovery or mid-move: settle and retry (the
-                # reference quiets the database first, QuietDatabase)
+                # a relocation/recovery slipped in after the quiet: settle
+                # and retry
                 await delay(1.0)
         raise AssertionError("consistency check could not complete")
 

@@ -1,0 +1,87 @@
+"""Verify drive: bulk load → tracker splits → clear → merge, with the
+durability oracle live and a kill in the middle; fuzz workloads riding."""
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.server.interfaces import GetKeyServersRequest, Tokens
+from foundationdb_tpu.workloads import ApiCorrectnessWorkload, run_workloads
+from foundationdb_tpu.workloads.quiet import quiet_database
+
+knobs = Knobs(
+    DD_SHARD_MAX_BYTES=4096, DD_SHARD_MIN_BYTES=2048, DD_TRACKER_INTERVAL=0.5
+)
+sim = Sim(seed=99, knobs=knobs)
+sim.activate()
+cluster = DynamicCluster(
+    sim,
+    ClusterConfig(n_storage=2, replication=2, n_tlogs=2, tlog_replication=2),
+    n_coordinators=3,
+)
+db = Database.from_coordinators(sim, cluster.coordinators)
+
+
+async def walk():
+    out, key = [], b""
+    while True:
+        r = await db._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+        )
+        out.append((r.begin, r.end))
+        if r.end is None:
+            return out
+        key = r.end
+
+
+async def body():
+    for batch in range(20):
+
+        async def w(tr, batch=batch):
+            for i in range(10):
+                tr.set(b"bulk/%03d/%02d" % (batch, i), b"x" * 200)
+
+        await db.run(w)
+    for _ in range(40):
+        await delay(1.0)
+        if len(await walk()) >= 4:
+            break
+    n_split = len(await walk())
+    assert n_split >= 4, n_split
+    print("split into", n_split, "shards", flush=True)
+
+    # kill the master mid-life; oracle checks recovery end version
+    for addr, p in list(sim.processes.items()):
+        w = getattr(p, "worker", None)
+        if w and p.alive and any(h.kind == "master" for h in w.roles.values()):
+            sim.kill_process(addr)
+            break
+
+    # fuzz battery still verifies across the recovery
+    await run_workloads(
+        [ApiCorrectnessWorkload(db, sim.loop.random.fork(), transactions=10)]
+    )
+    print("fuzz after recovery OK", flush=True)
+
+    async def clr(tr):
+        tr.clear_range(b"bulk/", b"bulk0")
+
+    await db.run(clr)
+    for _ in range(90):
+        await delay(1.0)
+        if len(await walk()) <= n_split - 2:
+            break
+    n_merged = len(await walk())
+    assert n_merged <= n_split - 2, (n_split, n_merged)
+    print("merged back to", n_merged, "shards", flush=True)
+
+    await quiet_database(db)
+    assert not sim.validation.violations
+    print(
+        "oracle: max acked", sim.validation.max_acked, "no violations",
+        flush=True,
+    )
+    return True
+
+
+print(sim.run_until_done(spawn(body()), 900.0))
