@@ -37,6 +37,7 @@ from ..server.interfaces import (
     ProxyInterface,
     Tokens,
 )
+from .loadbalance import QueueModel
 from .transaction import Transaction
 
 _METHOD_FOR_TOKEN = {
@@ -59,6 +60,9 @@ class Database:
         self.knobs: Knobs = sim.knobs
         self.client = sim.processes.get(client_addr) or sim.new_process(client_addr)
         self.rng = sim.loop.random.fork()
+        # per-replica latency/penalty model for read load balancing
+        # (fdbrpc/QueueModel.cpp analog; client/loadbalance.py)
+        self.queue_model = QueueModel()
         if proxy_ifaces is None and proxy_addrs is not None:
             proxy_ifaces = [ProxyInterface(a) for a in proxy_addrs]
         self._proxies: AsyncVar = AsyncVar(proxy_ifaces)

@@ -1,0 +1,135 @@
+"""Client-side replica selection: latency model + hedged second request.
+
+The analog of fdbrpc/LoadBalance.actor.h:158 loadBalance + QueueModel
+(fdbrpc/QueueModel.cpp): per-replica state (latency EWMA, outstanding
+requests, penalty/backoff window after failures) orders the team by
+expected queueing cost, and a SECOND request is hedged to the next-best
+replica when the first outlives its expected latency — tail reads ride
+the healthy replica instead of a stalled one.
+"""
+
+from __future__ import annotations
+
+from ..errors import WrongShardServer
+from ..net.sim import BrokenPromise, Endpoint
+from ..runtime.futures import delay, settled, wait_for_any
+from ..runtime.loop import Cancelled, now
+
+_ROTATE = (BrokenPromise, WrongShardServer)
+
+
+class QueueData:
+    __slots__ = ("latency", "penalty", "outstanding", "failed_until")
+
+    def __init__(self):
+        self.latency = 0.001  # EWMA of reply latency (QueueData defaults)
+        self.penalty = 1.0
+        self.outstanding = 0
+        self.failed_until = 0.0
+
+    def metric(self) -> tuple:
+        return (self.outstanding * self.penalty, self.latency)
+
+    def begin(self) -> None:
+        self.outstanding += 1
+
+    def end(self, dt: float, ok: bool) -> None:
+        self.outstanding = max(0, self.outstanding - 1)
+        if ok:
+            self.latency = 0.9 * self.latency + 0.1 * dt
+            self.penalty = max(1.0, self.penalty * 0.9)
+        else:
+            # brief avoidance window after a failure (failedUntil)
+            self.penalty = min(self.penalty * 2.0, 100.0)
+            self.failed_until = now() + 1.0
+
+
+class QueueModel:
+    def __init__(self):
+        self._data: dict[str, QueueData] = {}
+
+    def get(self, addr: str) -> QueueData:
+        d = self._data.get(addr)
+        if d is None:
+            d = self._data[addr] = QueueData()
+        return d
+
+    def order(self, team, rng) -> list:
+        """Replicas by expected cost; failed ones last. Ties broken by a
+        seeded shuffle so equal replicas share load."""
+        team = list(team)
+        rng.shuffle(team)
+        t = now()
+        return sorted(
+            team,
+            key=lambda a: (
+                self.get(a).failed_until > t,
+                self.get(a).metric(),
+            ),
+        )
+
+
+async def load_balanced_request(db, team, token: str, req, hedge: bool = True):
+    """One logical request against a replica team: best replica first,
+    hedged second request when the first outlives ~2x its expected
+    latency. Transport failures and moved shards (BrokenPromise /
+    WrongShardServer) rotate to the next replica; anything else (e.g.
+    FutureVersion) propagates to the caller's own retry policy. Raises
+    the last rotate-error when every replica fails.
+
+    Error-prone futures are raced via settled() (the codebase convention
+    — futures.py) so a fast error reply rotates instead of escaping, and
+    a hedge loser's cancellation is never recorded as replica failure."""
+    model: QueueModel = db.queue_model
+    order = model.order(team, db.rng)
+    last_err = None
+
+    async def one(addr):
+        d = model.get(addr)
+        d.begin()
+        t0 = now()
+        try:
+            r = await db.client.request(Endpoint(addr, token), req)
+            d.end(now() - t0, True)
+            return r
+        except Cancelled:
+            # hedge loser: losing a race is not a replica failure
+            d.outstanding = max(0, d.outstanding - 1)
+            raise
+        except BaseException:
+            d.end(now() - t0, False)
+            raise
+
+    i = 0
+    while i < len(order):
+        addr = order[i]
+        first = db.client.spawn(one(addr))
+        second = None
+        if hedge and i + 1 < len(order):
+            expected = max(model.get(addr).latency * 2.0, 0.002)
+            which = await wait_for_any([settled(first), delay(expected)])
+            if which != 0 and not first.is_ready():
+                # first is slow: hedge to the next-best replica
+                second = db.client.spawn(one(order[i + 1]))
+        pending = [f for f in (first, second) if f is not None]
+        advanced = 2 if second is not None else 1
+        while pending:
+            if len(pending) > 1:
+                await wait_for_any([settled(f) for f in pending])
+            else:
+                await settled(pending[0])
+            done = next(f for f in pending if f.is_ready())
+            pending.remove(done)
+            try:
+                r = done.get()
+                for p in pending:
+                    p.cancel()
+                return r
+            except _ROTATE as e:
+                last_err = e
+            except BaseException:
+                for p in pending:
+                    p.cancel()
+                raise
+        i += advanced
+    raise last_err or BrokenPromise("no replica answered")

@@ -34,6 +34,7 @@ from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay
+from .loadbalance import load_balanced_request
 from ..runtime.buggify import buggify
 from ..server.interfaces import (
     CommitRequest,
@@ -308,35 +309,28 @@ class Transaction:
 
     async def _load_balanced(self, key: bytes, token: str, req):
         """Replica selection with retry — LoadBalance.actor.h:158.
-        wrong_shard_server (a replica that moved the shard away, or a move
-        destination still fetching) tries the next replica, then refreshes
-        the location cache — NativeAPI's handling in getValue/getRange."""
+        Per-replica latency/penalty model + hedged second request
+        (client/loadbalance.py); wrong_shard_server or a dead team
+        refreshes the location cache — NativeAPI's handling in
+        getValue/getRange."""
         version_retries = 0
         last_err: Exception = None
         if buggify():
             self.db.invalidate_cache(key)  # stale-location path every read
         for attempt in range(MAX_READ_ATTEMPTS):
             _b, _e, team = await self.db._locate(key)
-            order = list(range(len(team)))
-            self.db.rng.shuffle(order)
-            for i in order:
-                ep = Endpoint(team[i], token)
-                try:
-                    return await self.db.client.request(ep, req)
-                except (BrokenPromise, WrongShardServer) as e:
-                    last_err = e
-                    continue
-                except FutureVersion as e:
-                    last_err = e
-                    break  # replica behind: wait, then retry the team
-            if isinstance(last_err, FutureVersion):
+            try:
+                return await load_balanced_request(self.db, team, token, req)
+            except FutureVersion as e:
+                last_err = e
                 version_retries += 1
                 if version_retries > 20:
-                    raise last_err
+                    raise
                 await delay(FUTURE_VERSION_RETRY_DELAY)
-            else:
+            except (BrokenPromise, WrongShardServer) as e:
                 # whole team unreachable or moved: drop cache, back off,
                 # re-locate
+                last_err = e
                 self.db.invalidate_cache(key)
                 await delay(0.1)
         raise last_err or BrokenPromise("read retries exhausted")
