@@ -97,10 +97,20 @@ def new_conflict_set(backend: str = "oracle", **kwargs) -> ConflictSet:
 
         return NativeConflictSet(**kwargs)
     if backend == "tpu":
+        # consult only ALREADY-initialized jax backends: jax.devices()
+        # would otherwise INITIALIZE one here — and on a box whose remote
+        # TPU tunnel is wedged, backend init can hang a whole simulation
+        # that never needed a device (round-3 failure mode). Processes
+        # that want the mesh initialize jax before building the cluster
+        # (tests/conftest, dryrun, real servers at boot).
+        multi = False
         try:
-            import jax
+            import jax._src.xla_bridge as xb
 
-            multi = len(jax.devices()) > 1
+            if xb._backends:
+                import jax
+
+                multi = len(jax.devices()) > 1
         except Exception:
             multi = False
         if multi:

@@ -27,6 +27,8 @@ class Knobs:
     STORAGE_TPU_INDEX = False  # TPU batched-read snapshot index
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
+    # multi-region log routing
+    ROUTER_BUFFER_BYTES = 1 << 20  # per-tag unacked relay buffer cap
     # data distribution (DataDistributionTracker.actor.cpp knobs
     # SHARD_MAX_BYTES_PER... scaled to sim data volumes)
     DD_SHARD_MAX_BYTES = 1 << 18  # split above this

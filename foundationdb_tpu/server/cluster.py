@@ -52,6 +52,10 @@ class ClusterConfig:
     replication: int = 1  # storage replicas per shard (team size)
     tlog_replication: int = 1  # tlog replicas per tag
     conflict_backend: str = "oracle"
+    # multi-region: a remote dc gets a LogRouter set + a storage mirror
+    # (regions config, fdbclient/DatabaseConfiguration.h:52)
+    remote_dc: str = ""
+    n_log_routers: int = 1
 
     def as_dict(self) -> dict:
         return dict(
@@ -62,6 +66,8 @@ class ClusterConfig:
             replication=self.replication,
             tlog_replication=self.tlog_replication,
             conflict_backend=self.conflict_backend,
+            remote_dc=self.remote_dc,
+            n_log_routers=self.n_log_routers,
         )
 
 
@@ -207,6 +213,29 @@ class DynamicCluster:
                 zone=zone_of(j),
             )
 
+        # remote region: storage mirror workers + router hosts in a
+        # second dc (never eligible for CC/master — the primary region
+        # runs the transaction subsystem)
+        if cfg.remote_dc:
+            r_classes = ["storage"] * cfg.n_storage + ["transaction"] * max(
+                cfg.n_log_routers, 1
+            )
+            for i, pclass in enumerate(r_classes):
+                addr = f"{prefix}remote{i}"
+                self.worker_addrs.append(addr)
+                sim.new_process(
+                    addr,
+                    boot=_make_worker_boot(
+                        self.coordinators,
+                        pclass,
+                        cfg.as_dict(),
+                        self.knobs,
+                        can_be_cc=False,
+                    ),
+                    zone=f"{prefix}{cfg.remote_dc}-z{i}",
+                    dc=cfg.remote_dc,
+                )
+
 
 def _boot_coordinator(process):
     async def run():
@@ -215,7 +244,7 @@ def _boot_coordinator(process):
     return run()
 
 
-def _make_worker_boot(coordinators, pclass, config, knobs):
+def _make_worker_boot(coordinators, pclass, config, knobs, can_be_cc=True):
     def boot(process):
         async def run():
             Worker(
@@ -224,6 +253,7 @@ def _make_worker_boot(coordinators, pclass, config, knobs):
                 process_class=pclass,
                 initial_config=config,
                 knobs=knobs,
+                can_be_cc=can_be_cc,
             ).start()
 
         return run()

@@ -172,6 +172,9 @@ class TLogPeekReply:
 class TLogPopRequest:
     tag: Tag = 0
     upto: Version = INVALID_VERSION
+    # consumer class: "ss" (storage / master) or "router" (remote-region
+    # LogRouter) — each keeps an independent pop frontier at the tlog
+    consumer: str = "ss"
 
 
 # -- storage (StorageServerInterface.h) ---------------------------------------
@@ -372,6 +375,12 @@ class ServerDBInfo:
     client_info: ClientDBInfo = None
     log_system: object = None  # log_system.LogSystemConfig
     recovery_version: Version = 0  # epoch-end of the previous generation
+    # multi-region: the remote region's LogRouter set as a
+    # LogSystemConfig (routers expose tlog-shaped peek/pop, so remote
+    # storage follows them with the ordinary PeekCursor), plus the
+    # remote storage mirror (tag → address for intra-region fetches)
+    log_routers: object = None
+    remote_storage: tuple = ()
 
 
 @dataclass

@@ -207,10 +207,11 @@ class PeekCursor:
     routed to the generation that owns `begin`, failing over across the
     tag's replicas inside that generation."""
 
-    def __init__(self, process, tag: int, config_var: AsyncVar):
+    def __init__(self, process, tag: int, config_var: AsyncVar, consumer="ss"):
         self.process = process
         self.tag = tag
         self.config_var = config_var  # AsyncVar[LogSystemConfig]
+        self.consumer = consumer  # pop-frontier class at the tlogs
         self._replica = 0  # failover rotation
 
     def _generation(self, cfg: LogSystemConfig, begin: int):
@@ -292,7 +293,10 @@ class PeekCursor:
             for log in s.logs_for_tag(self.tag):
                 futs.append(
                     self.process.request(
-                        log.ep("pop"), TLogPopRequest(tag=self.tag, upto=upto)
+                        log.ep("pop"),
+                        TLogPopRequest(
+                            tag=self.tag, upto=upto, consumer=self.consumer
+                        ),
                     )
                 )
         for f in futs:

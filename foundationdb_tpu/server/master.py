@@ -57,6 +57,7 @@ from .interfaces import (
 from .log_system import (
     LogSystemConfig,
     OldTLogSet,
+    TLogInterface,
     TLogSet,
     assign_tags,
     epoch_end_version,
@@ -171,6 +172,11 @@ class DBCoreState:
     shards: tuple = ()  # tuple[(begin, end, addrs, tags)]
     config: dict = field(default_factory=dict)  # cluster shape knobs
     log_ranges: dict = field(default_factory=dict)  # active backup captures
+    # multi-region: the remote region's router generation + its immortal
+    # remote storage mirrors (seeded once, like primary storage)
+    router_set: TLogSet = None
+    old_router_sets: tuple = ()  # tuple[OldTLogSet]
+    remote_storage: tuple = ()  # tuple[StorageInterface]
 
 
 class MasterTerminated(Exception):
@@ -248,7 +254,15 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
             break
         prev_count = len(workers)
         await delay(0.6)
-    picker = _RolePicker(workers, avoid={process.address})
+    # primary roles never land in the remote dc (the remote region hosts
+    # only routers + the storage mirror)
+    _rdc = str(config.get("remote_dc", "") or "")
+    primary_workers = (
+        [w for w in workers if getattr(w, "dc", "") != _rdc]
+        if _rdc
+        else workers
+    )
+    picker = _RolePicker(primary_workers, avoid={process.address})
 
     # storage: seeded once on a brand-new database, then immortal.
     # The live shard map = the coordinated-state snapshot + the txs-tag
@@ -306,6 +320,11 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         )
         shard_map = ShardMap.from_list(shards)
 
+    remote_dc = _rdc
+    # more routers than storage tags would leave tagless routers whose
+    # relayed version never advances — clamp
+    n_routers = max(1, min(int(config.get("n_log_routers", 1)), n_storage))
+
     # new tlog generation (uids carry the master uid: a failed prior
     # attempt at this recovery_count must not collide)
     tlog_workers = picker.pick("tlog", n_tlogs)
@@ -328,6 +347,9 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                         epoch=recovery_count,
                         tags=frozenset(log.tags),
                         first_version=recovery_version,
+                        # router pops keep an independent frontier so a
+                        # lagging remote region pins tlog data
+                        consumers=("ss", "router") if remote_dc else ("ss",),
                     ),
                 ),
             )
@@ -398,6 +420,108 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         )
         proxy_ifaces.append(ProxyInterface(address=w.address, uid=p_uid))
 
+    # multi-region: recruit this epoch's LogRouter set on remote-dc
+    # workers; seed the remote storage mirror on first recovery
+    # (LogRouter.actor.cpp:391 topology — remote region pulls the
+    # primary's streams asynchronously through routers)
+    router_set = None
+    old_router_sets: tuple = ()
+    remote_storage: tuple = ()
+    if remote_dc:
+        if prev:
+            remote_storage = tuple(prev.remote_storage)
+        remote_workers = [w for w in workers if getattr(w, "dc", "") == remote_dc]
+        if len(remote_workers) < max(n_routers, 1):
+            raise MasterTerminated(
+                f"remote dc {remote_dc!r} has too few workers"
+            )
+        rpicker = _RolePicker(remote_workers, avoid={process.address})
+        router_workers = rpicker.pick("tlog", n_routers)
+        router_logs = []
+        for i, w in enumerate(router_workers):
+            r_uid = f"router-{recovery_count}-{i}-{uid}"
+            rtags = tuple(t for t in range(n_storage) if t % n_routers == i)
+            await process.request(
+                Endpoint(w.address, Tokens.WORKER_RECRUIT),
+                RecruitRoleRequest(
+                    role="log_router",
+                    uid=r_uid,
+                    params=dict(
+                        tags=rtags,
+                        epoch=recovery_count,
+                        # start from 0, not the recovery version: the
+                        # primary tlogs retain exactly what the remote
+                        # region hasn't acked (the router consumer pop
+                        # frontier), so a replacement router backfills
+                        # everything a dead predecessor relayed-but-
+                        # unapplied or never relayed — starting at the
+                        # recovery version would skip commits made
+                        # between the old router's death and the fence
+                        first_version=0,
+                    ),
+                ),
+            )
+            router_logs.append(
+                TLogInterface(address=w.address, log_id=r_uid, tags=rtags)
+            )
+        router_set = TLogSet(
+            epoch=recovery_count, logs=tuple(router_logs), replication=1
+        )
+        # "old" router generations exist only so remote storage learns
+        # rollback boundaries and the cursor clamps at epoch ends —
+        # routers are STATELESS relays (replacements backfill from the
+        # primary's router-consumer retention), so every old entry points
+        # at the NEW router logs. A dead old router can never wedge the
+        # mirror the way a dead old tlog generation would.
+        if prev:
+            prior = list(prev.old_router_sets) + (
+                [OldTLogSet(set=prev.router_set, end_version=recovery_version)]
+                if prev.router_set is not None
+                else []
+            )
+            old_router_sets = tuple(
+                OldTLogSet(
+                    set=TLogSet(
+                        epoch=o.set.epoch,
+                        logs=tuple(router_logs),
+                        replication=1,
+                    ),
+                    end_version=o.end_version,
+                )
+                for o in prior
+            )
+        if not remote_storage:
+            # first recovery: seed the remote mirror — storage tag t in
+            # the remote dc owns the same ranges as primary tag t
+            storage_workers = sorted(
+                (w for w in remote_workers if w.process_class == "storage"),
+                key=lambda w: w.address,
+            )
+            assert len(storage_workers) >= n_storage, (
+                "remote dc needs n_storage storage-class workers"
+            )
+            seeded = []
+            for t in range(n_storage):
+                w = storage_workers[t]
+                s_uid = f"rss-{t}"
+                ranges = [
+                    (b, e) for b, e, _a, tags in shards if t in tags
+                ]
+                await process.request(
+                    Endpoint(w.address, Tokens.WORKER_RECRUIT),
+                    RecruitRoleRequest(
+                        role="storage",
+                        uid=s_uid,
+                        params=dict(
+                            tag=t, ranges=ranges, seed=True, remote=True
+                        ),
+                    ),
+                )
+                seeded.append(
+                    StorageInterface(address=w.address, uid=s_uid, tag=t)
+                )
+            remote_storage = tuple(seeded)
+
     # WRITING_CSTATE: fence. After this, the new generation is THE database.
     core = DBCoreState(
         recovery_count=recovery_count,
@@ -408,6 +532,9 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         shards=tuple(shards),
         config=config,
         log_ranges=dict(log_ranges),
+        router_set=router_set,
+        old_router_sets=old_router_sets,
+        remote_storage=remote_storage,
     )
     await cs.write(core)  # raises ClusterStateChanged if a successor fenced us
 
@@ -441,6 +568,16 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
             epoch=recovery_count, current=tlog_set, old=tuple(old_sets)
         ),
         recovery_version=recovery_version,
+        log_routers=(
+            LogSystemConfig(
+                epoch=recovery_count,
+                current=router_set,
+                old=old_router_sets,
+            )
+            if router_set is not None
+            else None
+        ),
+        remote_storage=tuple(remote_storage),
     )
     await process.request(
         Endpoint(cc_address, Tokens.CC_SET_DB_INFO), SetDBInfoRequest(info=info)
@@ -479,6 +616,11 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         [(i.ep("ping"), "proxy") for i in proxy_ifaces]
         + [(i.ep("ping"), "resolver") for i in resolver_ifaces]
         + [(log.ep("ping"), "tlog") for log in tlog_set.logs]
+        + (
+            [(log.ep("ping"), "log_router") for log in router_set.logs]
+            if router_set is not None
+            else []
+        )
     )
     aux = [
         process.spawn(
@@ -669,20 +811,27 @@ async def _wait_failure(process, watched, interval=0.3, misses_allowed=4):
 async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
     """Once every storage server's version passed the recovery version, the
     old tlog generations are no longer needed: rewrite the cstate without
-    them and republish (trackTlogRecovery, masterserver.actor.cpp:1009)."""
-    if not core.old_tlog_sets:
+    them and republish (trackTlogRecovery, masterserver.actor.cpp:1009).
+    With a remote region, the ROUTERS must also have relayed past the
+    recovery version — an old generation a router still needs must not be
+    dropped out from under the remote mirror."""
+    if not core.old_tlog_sets and not core.old_router_sets:
         return
-    while True:
-        await delay(1.0)
-        from ..runtime.futures import settled, wait_for_any
+    from ..runtime.futures import settled, wait_for_any
 
-        futs = [process.request(s.ep("version"), None) for s in storage]
+    async def _poll(eps):
+        futs = [process.request(ep, None) for ep in eps]
         deadline = delay(2.0)
         replies = []
         for f in futs:
             await wait_for_any([settled(f), deadline])
             if f.is_ready() and not f.is_error():
                 replies.append(f.get())
+        return replies
+
+    while True:
+        await delay(1.0)
+        replies = await _poll([s.ep("version") for s in storage])
         # a server counts as caught up only once it follows THIS epoch AND
         # has PERSISTED past the recovery version: before that its version
         # may contain a discarded pre-recovery tail it hasn't rolled back
@@ -691,10 +840,32 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
         # shards get re-replicated by DD (a long partition risks leaving
         # such a server permanently behind; the reference's per-server
         # popping is future work).
-        if replies and all(
+        ok = replies and all(
             epoch == core.recovery_count and durable > core.recovery_version
             for _version, durable, epoch in replies
-        ):
+        )
+        if ok and core.router_set is not None:
+            router_eps = [
+                Endpoint(log.address, f"router.version#{log.log_id}")
+                for log in core.router_set.logs
+            ]
+            r_replies = await _poll(router_eps)
+            ok = len(r_replies) == len(router_eps) and all(
+                v > core.recovery_version for v in r_replies
+            )
+        if ok and core.remote_storage:
+            # the remote mirror must have PERSISTED past the recovery
+            # version too: a router's relay buffer is memory — if the
+            # router died after relaying but before the mirror applied,
+            # only the old generations still hold that data
+            rs_replies = await _poll(
+                [s.ep("version") for s in core.remote_storage]
+            )
+            ok = len(rs_replies) == len(core.remote_storage) and all(
+                durable > core.recovery_version
+                for _v, durable, _e in rs_replies
+            )
+        if ok:
             break
     new_core = DBCoreState(
         recovery_count=core.recovery_count,
@@ -705,6 +876,9 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
         shards=core.shards,
         config=core.config,
         log_ranges=core.log_ranges,
+        router_set=core.router_set,
+        old_router_sets=(),
+        remote_storage=core.remote_storage,
     )
     try:
         await cs.write(new_core)
@@ -720,6 +894,14 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
             epoch=core.recovery_count, current=core.tlog_set, old=()
         ),
         recovery_version=core.recovery_version,
+        log_routers=(
+            LogSystemConfig(
+                epoch=core.recovery_count, current=core.router_set, old=()
+            )
+            if core.router_set is not None
+            else None
+        ),
+        remote_storage=tuple(core.remote_storage),
     )
     await process.request(
         Endpoint(cc_address, Tokens.CC_SET_DB_INFO), SetDBInfoRequest(info=new_info)

@@ -58,6 +58,7 @@ class StorageServer:
         uid: str = "",
         owned_ranges=None,  # [(begin, end)] | None = owns everything (tests)
         disk=None,  # SimDisk/RealDisk → durable engine; None = memory only
+        peer_for_tag=None,  # remote mirror: tag → peer address for fetches
     ):
         self.tag = tag
         self.log_config = log_config
@@ -102,6 +103,7 @@ class StorageServer:
         # undo: [(version, begin, end, prior [(b, e, state)])]
         self._shard_events: list = []
         self._fetch_generation = 0  # bumped on rollback: in-flight fetches restart
+        self._peer_for_tag = peer_for_tag
         # StorageServerMetrics (storageserver.actor.cpp:510): query/mutation
         # traffic + version gauges for status and ratekeeper-style lag views
         self.stats = CounterCollection("Storage", uid)
@@ -292,7 +294,24 @@ class StorageServer:
         state = self.owned[begin]
         held = state is not None
         if mine_now and not held:
-            # we're the destination: fetch the data (AddingShard)
+            # we're the destination: fetch the data (AddingShard). A
+            # REMOTE mirror fetches from its own region first (the old
+            # tags' mirror peers), with the primary's NEW team as
+            # fallback — a lagging mirror can apply this mutation after
+            # the primary's old team already dropped the range, and the
+            # old mirror peer may drop it mid-fetch too; the new primary
+            # team is guaranteed to hold it (finishMoveKeys gated on it).
+            sources = list(info["old_addrs"])
+            if self._peer_for_tag is not None:
+                peers = [
+                    a
+                    for a in (
+                        self._peer_for_tag(t) for t in info["old_tags"]
+                    )
+                    if a
+                ]
+                if peers:
+                    sources = peers + list(info["addrs"])
             trace(
                 SevInfo,
                 "FetchKeysBegin",
@@ -306,17 +325,17 @@ class StorageServer:
             )
             self.owned.insert(begin, end, ("adding", version))
             self._fetch_buffers[(begin, end)] = []
-            self._fetch_info[(begin, end)] = (tuple(info["old_addrs"]), version)
+            self._fetch_info[(begin, end)] = (tuple(sources), version)
             if self.engine is not None:
                 self._durable_queue.append(
                     (
                         "own",
                         version,
-                        (begin, end, ("adding", version, tuple(info["old_addrs"]))),
+                        (begin, end, ("adding", version, tuple(sources))),
                     )
                 )
             self.process.spawn(
-                self._fetch_keys(begin, end, info["old_addrs"], version)
+                self._fetch_keys(begin, end, sources, version)
             )
         elif not mine_now and held:
             # we were removed: drop the data and stop serving

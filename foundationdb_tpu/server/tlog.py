@@ -76,6 +76,7 @@ class TLog:
         log_id: str = "",
         first_version: Version = 0,
         disk=None,  # SimDisk/RealDisk → DiskQueue persistence; None = modeled
+        consumers: tuple = ("ss",),  # expected pop consumers per tag
     ):
         self.knobs = knobs or Knobs()
         self.tags = tags  # tags this tlog stores; None = all
@@ -92,7 +93,17 @@ class TLog:
         # version → durability future while an append+fsync is in flight;
         # duplicates await it instead of acking early
         self._pending: dict[Version, Future] = {}
-        self._popped: dict[int, Version] = {}  # tag → popped-through version
+        # consumer → {tag → popped-through version}. The reference gives
+        # remote log routers their own tag space so their pop frontier is
+        # independent of local storage's; here each CONSUMER CLASS keeps
+        # its own frontier per tag and trimming honors the minimum over
+        # every EXPECTED consumer — primary storage popping ahead of a
+        # lagging router can no longer truncate data the remote region
+        # hasn't relayed (TagPartitionedLogSystem's router-tag retention).
+        self.consumers = tuple(consumers)
+        self._pops: dict[str, dict[int, Version]] = {
+            c: {} for c in self.consumers
+        }
         self.dq = DiskQueue(disk, f"tlog-{log_id}") if disk is not None else None
         # every pushed dq entry (incl. empty versions), ascending:
         # [(version, start_offset, end_offset)]
@@ -303,10 +314,16 @@ class TLog:
                     out.append((v, msgs[req.tag]))
         return TLogPeekReply(messages=out, end_version=durable)
 
+    def _popped_for(self, tag: int) -> Version:
+        """Effective popped frontier: min over expected consumers."""
+        return min(self._pops[c].get(tag, 0) for c in self.consumers)
+
     async def pop(self, req: TLogPopRequest):
-        prev = self._popped.get(req.tag, 0)
+        consumer = getattr(req, "consumer", "ss") or "ss"
+        frontier = self._pops.setdefault(consumer, {})
+        prev = frontier.get(req.tag, 0)
         if req.upto > prev:
-            self._popped[req.tag] = req.upto
+            frontier[req.tag] = req.upto
             # the dq pop/compact section below suspends (commit/compact
             # awaits); serialize concurrent pop handlers through it so no
             # one calls dq.pop with offsets from a stale _dq_index
@@ -382,10 +399,14 @@ class TLog:
             live_tags.update(msgs)
         live_tags.discard(TXS_TAG)
         if live_tags:
-            horizon = min(self._popped.get(t, 0) for t in live_tags)
+            horizon = min(self._popped_for(t) for t in live_tags)
         else:
             horizon = self.version.get()  # only txs data remains
-        txs_popped = self._popped.get(TXS_TAG, 0)
+        # txs is popped by a recovering master only (one consumer class):
+        # take the max frontier, not the cross-consumer min
+        txs_popped = max(
+            (f.get(TXS_TAG, 0) for f in self._pops.values()), default=0
+        )
         if self._versions[0] > horizon:
             return horizon  # nothing at/below the horizon: no-op pop
         new_log = []

@@ -62,6 +62,7 @@ class Worker:
         self.knobs = knobs or process.sim.knobs
         self.db_info = AsyncVar(None)  # ServerDBInfo broadcast
         self.log_config = AsyncVar(None)  # LogSystemConfig for storage roles
+        self.router_config = AsyncVar(None)  # router set for REMOTE storage
         self.leader = AsyncVar(None)  # LeaderInfo of the current CC
         self.roles: dict[str, _RoleHandle] = {}
         self._cc = None  # ClusterController when we hold the leadership
@@ -218,6 +219,8 @@ class Worker:
             return None
         self.db_info.set(info)
         self.log_config.set(info.log_system)
+        if info.log_routers is not None:
+            self.router_config.set(info.log_routers)
         self._gc_roles(info)
         return None
 
@@ -225,16 +228,17 @@ class Worker:
         """Destroy role instances from epochs before info.recovery_count;
         tlogs live while any generation references their log_id."""
         live_logs = set()
-        if info.log_system is not None:
-            for log in info.log_system.current.logs:
-                live_logs.add(log.log_id)
-            for old in info.log_system.old:
-                for log in old.set.logs:
+        for cfg in (info.log_system, info.log_routers):
+            if cfg is not None:
+                for log in cfg.current.logs:
                     live_logs.add(log.log_id)
+                for old in cfg.old:
+                    for log in old.set.logs:
+                        live_logs.add(log.log_id)
         for uid, h in list(self.roles.items()):
             if h.kind == "storage":
                 continue
-            if h.kind == "tlog":
+            if h.kind in ("tlog", "log_router"):
                 if h.uid not in live_logs and h.epoch < info.recovery_count:
                     self._destroy(uid)
             elif h.epoch < info.recovery_count:
@@ -302,7 +306,15 @@ class Worker:
         h.actors.append(fut)
         return fut
 
-    def _make_tlog(self, h, epoch=0, tags=None, first_version=0, recover=False):
+    def _make_tlog(
+        self,
+        h,
+        epoch=0,
+        tags=None,
+        first_version=0,
+        recover=False,
+        consumers=("ss",),
+    ):
         from .tlog import TLog
 
         if isinstance(tags, list):
@@ -314,6 +326,7 @@ class Worker:
             log_id=h.uid,
             first_version=first_version,
             disk=self.disk,
+            consumers=tuple(consumers),
         )
         h.epoch, h.obj = epoch, tl
         self._spawn(h, tl.stats.trace_loop(5.0, self.process.address))
@@ -337,11 +350,29 @@ class Worker:
                         epoch=epoch,
                         tags=sorted(tags) if tags is not None else None,
                         first_version=first_version,
+                        consumers=list(consumers),
                     ),
                 )
                 tl.register_instance(self.process)
 
             self._spawn(h, manifest_then_serve())
+
+    def _make_log_router(self, h, tags=(), epoch=0, first_version=0):
+        from .log_router import LogRouter
+
+        lr = LogRouter(
+            self.knobs,
+            tags=tuple(tags),
+            epoch=epoch,
+            uid=h.uid,
+            log_config=self.log_config,
+            first_version=first_version,
+        )
+        h.epoch, h.obj = epoch, lr
+        lr.register_instance(self.process)
+        for t in lr.tags:
+            self._spawn(h, lr._pull(t))
+        self._spawn(h, lr.stats.trace_loop(5.0, self.process.address))
 
     def _make_resolver(self, h, backend="oracle", first_version=0, epoch=0):
         from .resolver import Resolver
@@ -385,7 +416,9 @@ class Worker:
         self._spawn(h, pr.rate_poller())
         self._spawn(h, pr.stats.trace_loop(5.0, self.process.address))
 
-    def _make_storage(self, h, tag=0, ranges=None, recover=False, seed=False):
+    def _make_storage(
+        self, h, tag=0, ranges=None, recover=False, seed=False, remote=False
+    ):
         from .storage import StorageServer
 
         # storage keeps well-known data tokens: strictly one per process
@@ -428,13 +461,26 @@ class Worker:
                 )
                 for b, e in ranges
             ]
+        def peer_for_tag(t):
+            info = self.db_info.get()
+            if info is None:
+                return None
+            for s in info.remote_storage:
+                if s.tag == t:
+                    return s.address
+            return None
+
         ss = StorageServer(
             tag=tag,
-            log_config=self.log_config,
+            # a REMOTE-region storage follows the LogRouter set (tlog-
+            # shaped relays of the primary's streams) instead of the
+            # primary tlogs directly (LogRouter.actor.cpp topology)
+            log_config=self.router_config if remote else self.log_config,
             knobs=self.knobs,
             uid=h.uid,
             owned_ranges=ranges if ranges is not None else [],
             disk=self.disk,
+            peer_for_tag=peer_for_tag if remote else None,
         )
         h.obj = ss
         ss.register_endpoints(self.process)
@@ -450,6 +496,7 @@ class Worker:
                     h.uid,
                     dict(
                         tag=tag,
+                        remote=remote,
                         ranges=[
                             [b.hex(), e.hex() if e is not None else None]
                             for b, e in (ranges or [])

@@ -11,7 +11,28 @@ Run: python -m foundationdb_tpu.tools.soak [n_seeds] [first_seed]
 
 from __future__ import annotations
 
+import os
 import sys
+
+# Simulations must NEVER touch the shared TPU tunnel: the soak's "tpu"
+# conflict backends run on their deterministic CPU twin (SURVEY.md §4),
+# and axon backend init hangs outright when the tunnel relay is wedged
+# (the round-3 failure mode). Same gate as tests/conftest.py.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _pin_cpu():
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+_pin_cpu()
 
 from ..client.database import Database
 from ..net.sim import Sim

@@ -9,6 +9,7 @@ proxy/master pair provides).
 
 from __future__ import annotations
 
+from ..errors import CommitUnknownResult, FdbError
 from ..runtime.futures import PromiseStream, StreamClosed
 from . import Workload
 
@@ -29,9 +30,26 @@ class SidebandWorkload(Workload):
 
     async def _mutator(self):
         for i in range(self.messages):
-            tr = self.db.transaction()
-            tr.set(self.prefix + b"%04d" % i, b"sent")
-            version = await tr.commit()
+            key = self.prefix + b"%04d" % i
+            while True:
+                tr = self.db.transaction()
+                tr.set(key, b"sent")
+                try:
+                    version = await tr.commit()
+                    break
+                except CommitUnknownResult:
+                    # did it land? A read that sees the key gives a read
+                    # version ≥ the commit version — a valid (stronger)
+                    # causality bound to report to the checker
+                    async def probe(t):
+                        return await t.get(key), await t.get_read_version()
+
+                    got, rv = await self.db.run(probe)
+                    if got == b"sent":
+                        version = rv
+                        break
+                except FdbError as e:
+                    await tr.on_error(e)
             self.stream.send((i, version))
         self.stream.close()
 
