@@ -37,7 +37,23 @@ from .sim import BrokenPromise, Endpoint
 
 
 class RemoteError(Exception):
-    """A remote handler raised a non-FdbError exception."""
+    """A remote handler raised an exception outside the named registry."""
+
+
+def _named_errors() -> dict:
+    """Framework exception classes reconstructed BY NAME across the wire —
+    server code catches these by type (e.g. the proxy's TLogStopped
+    handling marks the epoch dead), so flattening them to RemoteError in
+    the real-TCP personality would silently disable those paths (a
+    TLogStopped that stays RemoteError left a fenced proxy serving
+    forever — found by the TCP kill/restart soak)."""
+    from ..server.tlog import TLogStopped
+    from ..server.movekeys import MoveKeysError
+
+    return {
+        "TLogStopped": TLogStopped,
+        "MoveKeysError": MoveKeysError,
+    }
 
 
 class _Conn:
@@ -470,6 +486,11 @@ class RealWorld:
                     conn.send(("err", rid, "broken_promise", str(e)))
                     return
                 except BaseException as e:
+                    if type(e).__name__ in _named_errors():
+                        conn.send(
+                            ("err", rid, "named", (type(e).__name__, str(e)))
+                        )
+                        return
                     conn.send(("err", rid, "remote", repr(e)))
                     return
                 conn.send(("ok", rid, result))
@@ -494,6 +515,10 @@ class RealWorld:
                 ent[0]._set_error(cls(str(detail)))
             elif etype == "broken_promise":
                 ent[0]._set_error(BrokenPromise(str(detail)))
+            elif etype == "named":
+                name, text = detail
+                cls = _named_errors().get(str(name), RemoteError)
+                ent[0]._set_error(cls(str(text)))
             else:
                 ent[0]._set_error(RemoteError(str(detail)))
         else:

@@ -836,11 +836,13 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
         # has PERSISTED past the recovery version: before that its version
         # may contain a discarded pre-recovery tail it hasn't rolled back
         # yet, and a reboot would still need the old generation's data.
-        # Unreachable servers don't pin the old generation — a dead one's
-        # shards get re-replicated by DD (a long partition risks leaving
-        # such a server permanently behind; the reference's per-server
-        # popping is future work).
-        ok = replies and all(
+        # An UNREACHABLE server pins the old generations too: at
+        # replication=1 its acked-but-unpersisted tail exists ONLY there —
+        # dropping them while it reboots destroys acknowledged commits
+        # (found by the TCP kill/restart soak). A permanently-dead server
+        # thus pins old tlogs until exclusion removes it; the reference's
+        # per-tag pop-on-removal is the eventual cleanup path.
+        ok = len(replies) == len(storage) and all(
             epoch == core.recovery_count and durable > core.recovery_version
             for _version, durable, epoch in replies
         )
@@ -861,9 +863,13 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
             rs_replies = await _poll(
                 [s.ep("version") for s in core.remote_storage]
             )
+            # the mirror must FOLLOW this epoch too: durable progress made
+            # while still on the old router generation may contain a
+            # discarded pre-recovery tail it hasn't rolled back yet
             ok = len(rs_replies) == len(core.remote_storage) and all(
-                durable > core.recovery_version
-                for _v, durable, _e in rs_replies
+                epoch == core.recovery_count
+                and durable > core.recovery_version
+                for _v, durable, epoch in rs_replies
             )
         if ok:
             break

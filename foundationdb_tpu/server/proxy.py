@@ -416,22 +416,49 @@ class Proxy:
         try:
             await self._commit_batch(batch, local_n, vfut)
         except TLogStopped as e:
-            # this epoch is over: a recovering master locked our tlogs
+            # this epoch is over: a recovering master locked our tlogs.
+            # EXPECTED end-of-life, not an actor crash — re-raising would
+            # kill the hosting worker process on a real server
+            # (die-on-actor-error), taking co-hosted roles with it
             self.failed = True
             # wake GRVs parked on the rate gate so they see failure
             self._grv_replenished.trigger()
             for f in replies:
                 if not f.is_ready():
                     f._set_error(BrokenPromise(str(e)))
-            raise
-        except BaseException as e:
+            from ..runtime.trace import SevInfo, trace
+
+            trace(
+                SevInfo,
+                "ProxyEpochEnded",
+                getattr(self.process, "address", ""),
+                Uid=self.uid,
+                Epoch=self.epoch,
+                Err=str(e),
+            )
+        except Exception as e:
             # a failed dependency (master/resolver/tlog unreachable) must
             # error every pending commit, not leave clients hanging; they
-            # see it as commit_unknown_result
+            # see it as commit_unknown_result. Swallow after reporting:
+            # the clients have their answer and the batch actor's death
+            # must not take the worker process down with it.
+            # (Exception, NOT BaseException: KeyboardInterrupt/SystemExit
+            # must still stop a real server.)
             for f in replies:
                 if not f.is_ready():
                     f._set_error(e)
-            raise
+            from ..runtime.loop import Cancelled
+            from ..runtime.trace import SevWarn, trace
+
+            if isinstance(e, Cancelled):
+                raise
+            trace(
+                SevWarn,
+                "CommitBatchFailed",
+                getattr(self.process, "address", ""),
+                Uid=self.uid,
+                Err=repr(e),
+            )
         finally:
             # a batch that died before its ordered phases must not wedge
             # its successors on the gates

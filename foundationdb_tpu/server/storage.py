@@ -392,9 +392,20 @@ class StorageServer:
                 )
             except TransactionTooOld:
                 # fell out of the source's MVCC window: restart at a newer
-                # snapshot; buffered mutations ≤ it are covered by it
-                at_version = self.version.get()
+                # snapshot; buffered mutations ≤ it are covered by it. A
+                # REMOTE mirror lagging past the whole window would loop
+                # forever re-picking its own stale version — jump forward
+                # by half a window each round (the splice below waits for
+                # the stream to catch up to at_version, so a snapshot
+                # ahead of the stream stays correct)
+                at_version = max(
+                    self.version.get(),
+                    at_version
+                    + self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS // 2,
+                )
                 rows, lo = [], begin
+                src_i += 1
+                await delay(0.1)
                 continue
             except Exception:
                 src_i += 1
@@ -406,6 +417,15 @@ class StorageServer:
             lo = reply.data[-1][0] + b"\x00"
         if generation != self._fetch_generation:
             return  # a rollback restarted this fetch; the new actor owns it
+        # the snapshot may be AHEAD of our mutation stream (a lagging
+        # mirror fetching at a fresh version): stay in 'adding' (stream
+        # mutations keep buffering) until the stream reaches at_version,
+        # or post-splice stream mutations ≤ at_version would double-apply
+        # onto a snapshot that already contains them
+        while self.version.get() < at_version:
+            await self.version.on_change()
+            if generation != self._fetch_generation:
+                return
         cur = self.owned[begin]
         if cur is None or cur[0] != "adding":
             return  # the move was undone (rollback) or superseded
