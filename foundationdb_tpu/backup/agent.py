@@ -225,7 +225,9 @@ async def restore(db, container) -> int:
                 _apply_to_txn(tr, m)
 
         await db.run(apply)
-    return len(snapshot)
+    # rows loaded + log mutations replayed (a backup begun before any
+    # write has an EMPTY snapshot and everything in the log)
+    return len(snapshot) + len(log)
 
 
 def _apply_to_txn(tr, m: Mutation) -> None:

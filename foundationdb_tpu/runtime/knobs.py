@@ -102,3 +102,22 @@ class Knobs:
         )
         if rng.coinflip(0.25):
             self.SIM_MAX_LATENCY = rng.random_choice([0.001, 0.003, 0.02])
+        if rng.coinflip(0.25):
+            self.SIM_FAST_LATENCY = rng.random_choice([0.0002, 0.0008, 0.004])
+        if rng.coinflip(0.25):
+            self.COMMIT_BATCH_INTERVAL_FROM_IDLE = rng.random_choice(
+                [0.0001, 0.0005, 0.005]
+            )
+        if rng.coinflip(0.25):
+            self.ROUTER_BUFFER_BYTES = rng.random_choice([512, 1 << 14, 1 << 20])
+        if rng.coinflip(0.25):
+            self.DD_SHARD_MAX_BYTES = rng.random_choice([2048, 1 << 16, 1 << 18])
+            self.DD_SHARD_MIN_BYTES = self.DD_SHARD_MAX_BYTES // 8
+        if rng.coinflip(0.25):
+            self.DD_TRACKER_INTERVAL = rng.random_choice([0.3, 2.0, 10.0])
+        if rng.coinflip(0.25):
+            self.DD_MOVE_THROTTLE = rng.random_choice([0.0, 0.5, 2.0])
+        if rng.coinflip(0.25):
+            self.RK_MAX_TPS = rng.random_choice([500.0, 10_000.0, 100_000.0])
+        if rng.coinflip(0.25):
+            self.GRV_BATCH_INTERVAL = rng.random_choice([0.0002, 0.0005, 0.002])

@@ -41,13 +41,16 @@ from ..runtime.knobs import Knobs
 from ..server.cluster import ClusterConfig, DynamicCluster
 from ..workloads import (
     ApiCorrectnessWorkload,
+    AtomicOpsWorkload,
     AttritionWorkload,
+    BackupWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
     RandomCloggingWorkload,
     RywFuzzWorkload,
     SerializabilityWorkload,
     SidebandWorkload,
+    WatchesWorkload,
     run_workloads,
 )
 
@@ -102,6 +105,17 @@ def run_one(seed: int, verbose: bool = False) -> dict:
             )
             for i in range(2)
         ]
+    if shape_rng.coinflip(0.5):
+        workloads += [
+            AtomicOpsWorkload(
+                db, rng.fork(), transactions=12, client_id=i, client_count=2
+            )
+            for i in range(2)
+        ]
+    if shape_rng.coinflip(0.4):
+        workloads.append(WatchesWorkload(db, rng.fork(), changes=8))
+    if shape_rng.coinflip(0.3):
+        workloads.append(BackupWorkload(db, rng.fork(), sim=sim, writes=15))
     if kills and cfg.replication > 1:
         workloads.append(
             AttritionWorkload(
