@@ -199,10 +199,18 @@ class Database:
         self.client.spawn(self._watch_actor(key, out))
         return out
 
-    async def _watch_actor(self, key: bytes, out) -> None:
+    async def _watch_actor(self, key: bytes, out, baseline_version=None) -> None:
         """Register (and keep re-registering across failovers/moves) a
-        storage watch; resolve `out` with the new value."""
-        from ..errors import FdbError
+        storage watch; resolve `out` with the new value.
+
+        ``baseline_version``: the WATCHING transaction's read version —
+        the baseline value must be read there (fdb_transaction_watch
+        semantics: a watch fires on change from the value the
+        transaction saw). Reading it at a fresh version instead silently
+        adopted any change that landed in between as the new baseline,
+        and the watch then never fired for it (a permanent lost wakeup —
+        found by the Watches workload in the chaos soak)."""
+        from ..errors import FdbError, TransactionTooOld
         from ..server.interfaces import Tokens as T
         from ..server.interfaces import WatchValueRequest
 
@@ -215,7 +223,22 @@ class Database:
                     # the baseline is captured ONCE: a change landing
                     # during a failover retry must still fire the watch,
                     # not silently become the new baseline
-                    v0 = await tr.get(key, snapshot=True)
+                    if baseline_version is not None:
+                        try:
+                            tr.set_read_version(baseline_version)
+                            v0 = await tr.get(key, snapshot=True)
+                        except TransactionTooOld:
+                            # the txn's version fell out of the MVCC
+                            # window — the value may have changed since,
+                            # unobservably: fire (watches may fire
+                            # spuriously; they must never be lost)
+                            tr = self.transaction()
+                            v0 = await tr.get(key, snapshot=True)
+                            if not out.is_ready():
+                                out._set(v0)
+                            return
+                    else:
+                        v0 = await tr.get(key, snapshot=True)
                     baseline_known = True
                 else:
                     await tr.get_read_version()

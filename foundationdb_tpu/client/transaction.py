@@ -366,7 +366,14 @@ class Transaction:
 
     def _start_watches(self) -> None:
         for key, fut in self._watches:
-            self.db.client.spawn(self.db._watch_actor(key, fut))
+            # the baseline is THIS transaction's read version (when it
+            # read anything): the watch fires on change from what this
+            # transaction saw, not from some later state
+            self.db.client.spawn(
+                self.db._watch_actor(
+                    key, fut, baseline_version=self._read_version
+                )
+            )
         self._watches = []
 
     def get_versionstamp(self) -> bytes:

@@ -1,0 +1,44 @@
+"""SystemMonitor: periodic process-health trace events.
+
+The analog of flow/SystemMonitor.cpp (systemMonitor → ProcessMetrics /
+MachineMetrics / NetworkMetrics): every interval, one trace event with the
+process's vitals — run-loop lag (scheduling delay of a zero-delay timer,
+the reference's S2Pri/loop-busyness signal), live actor count, posted-
+queue depth, memory use, and event-loop personality. Used by real servers
+(tools/fdbserver spawns it per process) and available to sims."""
+
+from __future__ import annotations
+
+from .loop import current_loop, now
+from .trace import SevInfo, trace
+
+
+async def system_monitor(process, interval: float = 5.0):
+    from .futures import delay
+
+    loop = current_loop()
+    last = now()
+    while True:
+        before = now()
+        await delay(interval)
+        lag = max(0.0, (now() - before) - interval)
+        try:
+            import resource
+
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        except Exception:
+            rss_kb = 0
+        coll = getattr(process, "actors", None)
+        n_actors = len(getattr(coll, "_actors", []) or [])
+        trace(
+            SevInfo,
+            "ProcessMetrics",
+            getattr(process, "address", ""),
+            Elapsed=round(now() - last, 3),
+            RunLoopLag=round(lag, 6),
+            Actors=n_actors,
+            Endpoints=len(getattr(process, "endpoints", {}) or {}),
+            QueueDepth=len(getattr(loop, "_queue", []) or []),
+            MemoryKB=rss_kb,
+        )
+        last = now()

@@ -266,18 +266,19 @@ class PeekCursor:
                     # this replica is durable through the generation's end —
                     # the whole old generation is consumed; advance past it
                     end = clamp
-                else:
-                    # The replica's durable version stops short of the
-                    # recovery-retained end: versions (end, clamp] may exist
-                    # on another replica (lock only guarantees >= 1 locked
-                    # replica per tag), so advancing to clamp here would
-                    # silently skip them (the reference's merge-cursor /
-                    # known-committed handling). Advance only to what this
-                    # replica proved; if that is no progress, fail over.
-                    if end <= begin and not msgs:
-                        self._replica += 1
-                        await delay(0.05)
-                        continue
+            # No progress — a STOPPED (or behind) replica answers
+            # immediately instead of long-polling, and versions above its
+            # durable end may exist on another replica (lock only
+            # guarantees >= 1 locked replica per tag). Back off and fail
+            # over: without the delay this is a HOT LOOP that pins the
+            # event loop of a real server whose storage is caught up to a
+            # fenced tlog (found by the fdbmonitor restart soak — the
+            # spinning worker starved the very lock/recovery traffic that
+            # would have produced a new generation to follow).
+            if end <= begin and not msgs:
+                self._replica += 1
+                await delay(0.05)
+                continue
             return msgs, end
 
     async def pop(self, upto: int) -> None:

@@ -199,6 +199,11 @@ class Proxy:
         # GRV batching toward the master (transactionStarter batching);
         # created lazily — self.process is bound at register() time
         self._grv_batcher = None
+        # consecutive master-unreachable batch failures: a proxy whose
+        # master is gone dies with it (the reference proxy's lifetime is
+        # tied to its master via waitFailure) instead of spamming empty
+        # batches at a dead endpoint forever
+        self._master_misses = 0
         # ProxyStats (MasterProxyServer.actor.cpp:60): commit/GRV traffic
         # counters + latency samples, traced periodically and served to the
         # status aggregator via the metrics endpoint
@@ -358,7 +363,7 @@ class Proxy:
             self._l_commit.add(now() - t0)
 
     async def batcher_loop(self):
-        while True:
+        while not self.failed:
             from_idle = False
             if not self._batch:
                 self._work = Future()
@@ -459,6 +464,18 @@ class Proxy:
                 Uid=self.uid,
                 Err=repr(e),
             )
+            if isinstance(e, BrokenPromise) and "master" in str(e):
+                self._master_misses += 1
+                if self._master_misses >= 8:
+                    trace(
+                        SevWarn,
+                        "ProxyMasterGone",
+                        getattr(self.process, "address", ""),
+                        Uid=self.uid,
+                    )
+                    self.close()
+        else:
+            self._master_misses = 0
         finally:
             # a batch that died before its ordered phases must not wedge
             # its successors on the gates

@@ -17,7 +17,13 @@ surface of RealWorld is the whole porting layer.
 from __future__ import annotations
 
 import argparse
+import faulthandler
+import signal
 import sys
+
+# live stack dump on demand (kill -USR1 <pid>): the debugging hook for a
+# wedged server (the reference's slow-task profiler serves this role)
+faulthandler.register(signal.SIGUSR1, all_threads=True)
 
 
 def parse_config(text: str) -> dict:
@@ -104,6 +110,11 @@ def main(argv=None) -> int:
             initial_config=parse_config(args.config),
             knobs=knobs,
         ).start()
+
+    # SystemMonitor: periodic ProcessMetrics trace (flow/SystemMonitor.cpp)
+    from ..runtime.monitor import system_monitor
+
+    world.node.spawn(system_monitor(world.node))
 
     print(f"fdbserver: {args.role} listening on {args.listen}", flush=True)
     try:
