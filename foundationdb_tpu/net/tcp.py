@@ -57,42 +57,110 @@ def _named_errors() -> dict:
 
 
 class _Conn:
-    """One TCP connection (either direction) with framing + dispatch."""
+    """One TCP connection (either direction) with framing + dispatch.
 
-    def __init__(self, world: "RealWorld", sock: socket.socket, peer: Optional[str]):
+    With a TLS-enabled world the socket is an SSLSocket whose handshake is
+    driven HERE, non-blocking (the reference's TLSConnection wraps its
+    streams the same way, fdbrpc/TLSConnection.actor.cpp): until the
+    handshake completes, reads/writes feed the handshake; the wire
+    preamble and frames flow only after it."""
+
+    def __init__(
+        self,
+        world: "RealWorld",
+        sock: socket.socket,
+        peer: Optional[str],
+        preamble: bytes = b"",
+    ):
         self.world = world
         self.sock = sock
         self.peer = peer  # peer's listen address (None until handshake)
         self.inbuf = bytearray()
-        self.outbuf = bytearray()
+        # the wire preamble MUST be queued before the TLS drive below: a
+        # handshake that completes synchronously flushes the outbuf, and
+        # bytes appended afterwards would strand with no writer
+        self.outbuf = bytearray(preamble)
         self.closed = False
         self.handshaken = peer is not None and False  # always expect preamble
+        import ssl as _ssl
+
+        self._tls_handshaking = isinstance(sock, _ssl.SSLSocket)
+        self._tls_write_wants_read = False
         sock.setblocking(False)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
         world.loop.add_reader(sock, self._on_readable)
+        if self._tls_handshaking:
+            self._drive_tls()
+
+    def _drive_tls(self) -> None:
+        import ssl as _ssl
+
+        try:
+            self.sock.do_handshake()
+        except _ssl.SSLWantReadError:
+            return  # reader is always registered
+        except _ssl.SSLWantWriteError:
+            self.world.loop.add_writer(self.sock, self._on_writable)
+            return
+        except (_ssl.SSLError, OSError) as e:
+            trace(
+                SevWarn,
+                "TLSHandshakeFailed",
+                self.world.node.address,
+                Err=str(e)[:200],
+            )
+            self.close()
+            return
+        self._tls_handshaking = False
+        if self.outbuf:
+            self._on_writable()
+            if self.outbuf and not self.closed:
+                self.world.loop.add_writer(self.sock, self._on_writable)
+        # application bytes may have arrived WITH the handshake's last
+        # flight and now sit decrypted inside the SSL object — the fd
+        # will never signal readable for them again
+        pending = getattr(self.sock, "pending", None)
+        if not self.closed and pending is not None and pending():
+            self._on_readable()
 
     def send(self, msg: Any) -> None:
         if self.closed:
             return
-        frame = wire.encode_frame(wire.encode_value(msg))
-        first = not self.outbuf
-        self.outbuf += frame
-        if first:
-            self._on_writable()  # opportunistic immediate write
+        self.outbuf += wire.encode_frame(wire.encode_value(msg))
+        if not self._tls_handshaking:
+            # always attempt the flush and (re)arm the writer on leftover:
+            # assuming "non-empty outbuf implies a registered writer" once
+            # stranded a preamble queued right after a synchronously-
+            # completing TLS handshake
+            self._on_writable()
             if self.outbuf and not self.closed:
                 self.world.loop.add_writer(self.sock, self._on_writable)
 
     def _on_writable(self) -> None:
+        if self._tls_handshaking:
+            self.world.loop.remove_writer(self.sock)
+            self._drive_tls()
+            return
+        import ssl as _ssl
+
         try:
             while self.outbuf:
                 n = self.sock.send(self.outbuf)
                 if n <= 0:
                     break
                 del self.outbuf[:n]
-        except (BlockingIOError, InterruptedError):
+        except _ssl.SSLWantReadError:
+            # the SSL layer must READ (a post-handshake record) before
+            # this write can proceed; keeping the writer armed would
+            # busy-spin on an always-writable fd — retry from the read
+            # path instead
+            self._tls_write_wants_read = True
+            self.world.loop.remove_writer(self.sock)
+            return
+        except (BlockingIOError, InterruptedError, _ssl.SSLWantWriteError):
             pass
         except OSError:
             self.close()
@@ -101,9 +169,26 @@ class _Conn:
             self.world.loop.remove_writer(self.sock)
 
     def _on_readable(self) -> None:
+        if self._tls_handshaking:
+            self._drive_tls()
+            if self._tls_handshaking or self.closed:
+                return
+        if self._tls_write_wants_read and not self.closed:
+            # a stalled write was waiting on inbound TLS records
+            self._tls_write_wants_read = False
+            self._on_writable()
+            if self.outbuf and not self.closed and not self._tls_write_wants_read:
+                self.world.loop.add_writer(self.sock, self._on_writable)
+            if self.closed:
+                return
+        import ssl as _ssl
+
         try:
             data = self.sock.recv(1 << 16)
-        except (BlockingIOError, InterruptedError):
+        except (BlockingIOError, InterruptedError, _ssl.SSLWantReadError):
+            return
+        except (_ssl.SSLWantWriteError,):
+            self.world.loop.add_writer(self.sock, self._on_writable)
             return
         except OSError:
             self.close()
@@ -112,6 +197,17 @@ class _Conn:
             self.close()
             return
         self.inbuf += data
+        # drain TLS-internal plaintext: decrypted bytes can sit in the SSL
+        # buffer with no fd readiness to re-trigger select
+        pending = getattr(self.sock, "pending", None)
+        while pending is not None and pending():
+            try:
+                more = self.sock.recv(1 << 16)
+            except (_ssl.SSLWantReadError, BlockingIOError):
+                break
+            if not more:
+                break
+            self.inbuf += more
         try:
             if not self.handshaken:
                 hs = wire.parse_handshake(self.inbuf)
@@ -216,10 +312,29 @@ class RealWorld:
         zone: Optional[str] = None,
         dc: str = "dc0",
         die_on_actor_error: bool = False,
+        tls: Optional[dict] = None,  # {certfile, keyfile, cafile}
     ):
         self.loop = loop or RealLoop(seed)
         self.knobs = knobs or Knobs()
         self.die_on_actor_error = die_on_actor_error
+        # mutual TLS (the reference's TLS plugin, fdbrpc/TLSConnection):
+        # every connection in either direction presents the cluster cert
+        # and verifies the peer against the cluster CA — plaintext peers
+        # cannot join or talk to a TLS cluster
+        self._tls_server_ctx = self._tls_client_ctx = None
+        if tls:
+            import ssl as _ssl
+
+            sctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            sctx.load_cert_chain(tls["certfile"], tls["keyfile"])
+            sctx.load_verify_locations(tls["cafile"])
+            sctx.verify_mode = _ssl.CERT_REQUIRED
+            cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            cctx.load_cert_chain(tls["certfile"], tls["keyfile"])
+            cctx.load_verify_locations(tls["cafile"])
+            cctx.check_hostname = False  # peers are addressed by ip:port
+            cctx.verify_mode = _ssl.CERT_REQUIRED
+            self._tls_server_ctx, self._tls_client_ctx = sctx, cctx
         self.data_dir = data_dir
         self.zone = zone
         self.dc = dc
@@ -306,11 +421,28 @@ class RealWorld:
                 return
             except OSError:
                 return
-            conn = _Conn(self, sock, None)
-            conn.outbuf += wire.handshake_bytes(self.node.address)
-            conn._on_writable()
-            if conn.outbuf and not conn.closed:
-                self.loop.add_writer(sock, conn._on_writable)
+            if self._tls_server_ctx is not None:
+                try:
+                    sock.setblocking(False)
+                    sock = self._tls_server_ctx.wrap_socket(
+                        sock, server_side=True, do_handshake_on_connect=False
+                    )
+                except Exception as e:
+                    trace(
+                        SevWarn,
+                        "TLSAcceptFailed",
+                        self.node.address,
+                        Err=str(e)[:200],
+                    )
+                    sock.close()
+                    continue
+            conn = _Conn(
+                self, sock, None, preamble=wire.handshake_bytes(self.node.address)
+            )
+            if not conn._tls_handshaking and not conn.closed:
+                conn._on_writable()
+                if conn.outbuf and not conn.closed:
+                    self.loop.add_writer(sock, conn._on_writable)
             if not conn.closed:
                 self._anon.append(conn)
 
@@ -382,13 +514,49 @@ class RealWorld:
             waiter._set_error(BrokenPromise(f"connect {peer}: {e}"))
             return waiter
 
-        conn = _Conn(self, sock, peer)
+        if self._tls_client_ctx is not None:
+            # TLS: the _Conn (and its SSL wrap) exists only once the TCP
+            # connect completes; until then failures resolve the waiter
+            # directly
+            def on_tcp_connected():
+                self.loop.remove_writer(sock)
+                err = sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+                if err:
+                    sock.close()
+                    self._connecting.pop(peer, None)
+                    if not waiter.is_ready():
+                        waiter._set_error(
+                            BrokenPromise(f"connect to {peer} failed")
+                        )
+                    return
+                try:
+                    wrapped = self._tls_client_ctx.wrap_socket(
+                        sock, do_handshake_on_connect=False
+                    )
+                except Exception as e:
+                    sock.close()
+                    self._connecting.pop(peer, None)
+                    if not waiter.is_ready():
+                        waiter._set_error(BrokenPromise(f"tls {peer}: {e}"))
+                    return
+                _Conn(
+                    self,
+                    wrapped,
+                    peer,
+                    preamble=wire.handshake_bytes(self.node.address),
+                )
+
+            self.loop.add_writer(sock, on_tcp_connected)
+            return waiter
+
         # queue our preamble NOW: on localhost the peer's preamble can
         # arrive (and resolve the connect waiter) before the writability
         # callback below ever runs — a request sent at that moment must
         # find the handshake already ahead of it in the buffer, or the
         # first frame beats the preamble onto the wire
-        conn.outbuf += wire.handshake_bytes(self.node.address)
+        conn = _Conn(
+            self, sock, peer, preamble=wire.handshake_bytes(self.node.address)
+        )
 
         def on_connected():
             if conn.closed:

@@ -186,6 +186,9 @@ def main(argv=None) -> int:
     ap.add_argument("-C", "--cluster", required=True, help="coordinator list")
     ap.add_argument("--exec", dest="cmds", action="append", default=[])
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--tls-cert", default=None)
+    ap.add_argument("--tls-key", default=None)
+    ap.add_argument("--tls-ca", default=None)
     args = ap.parse_args(argv)
 
     from ..client.database import Database
@@ -193,7 +196,14 @@ def main(argv=None) -> int:
     from ..runtime.futures import spawn
 
     coordinators = [c for c in args.cluster.split(",") if c]
-    world = RealWorld("127.0.0.1:0")
+    tls = None
+    if args.tls_cert or args.tls_key or args.tls_ca:
+        if not (args.tls_cert and args.tls_key and args.tls_ca):
+            ap.error("--tls-cert, --tls-key and --tls-ca go together")
+        tls = dict(
+            certfile=args.tls_cert, keyfile=args.tls_key, cafile=args.tls_ca
+        )
+    world = RealWorld("127.0.0.1:0", tls=tls)
     world.activate()
     db = Database.from_coordinators(world, coordinators)
     cli = FdbCli(db, coordinators)

@@ -54,6 +54,9 @@ def main(argv=None) -> int:
     ap.add_argument("--zone", default=None)
     ap.add_argument("--dc", default="dc0")
     ap.add_argument("--tracefile", default=None, help="JSONL trace output")
+    ap.add_argument("--tls-cert", default=None, help="PEM certificate chain")
+    ap.add_argument("--tls-key", default=None, help="PEM private key")
+    ap.add_argument("--tls-ca", default=None, help="PEM CA bundle (mutual auth)")
     ap.add_argument(
         "--knob",
         action="append",
@@ -83,6 +86,14 @@ def main(argv=None) -> int:
         knob_overrides[name.upper()] = parsed
     knobs = Knobs(**knob_overrides)
 
+    tls = None
+    if args.tls_cert or args.tls_key or args.tls_ca:
+        if not (args.tls_cert and args.tls_key and args.tls_ca):
+            ap.error("--tls-cert, --tls-key and --tls-ca go together")
+        tls = dict(
+            certfile=args.tls_cert, keyfile=args.tls_key, cafile=args.tls_ca
+        )
+
     world = RealWorld(
         args.listen,
         knobs=knobs,
@@ -90,6 +101,7 @@ def main(argv=None) -> int:
         zone=args.zone,
         dc=args.dc,
         die_on_actor_error=True,  # a server with a dead actor must crash loudly
+        tls=tls,
     )
     world.activate()
 

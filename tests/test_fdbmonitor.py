@@ -9,21 +9,9 @@ import subprocess
 import sys
 import time
 
-from foundationdb_tpu.tools.tcp_soak import fdbcli
+from foundationdb_tpu.tools.tcp_soak import fdbcli, free_ports
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def free_ports(n):
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 def test_fdbmonitor_supervises_cluster(tmp_path):
