@@ -40,6 +40,9 @@ from ..server.interfaces import (
 from .loadbalance import QueueModel
 from .transaction import Transaction
 
+# distinct from None: a cleared key's baseline value IS None
+_NO_VALUE = object()
+
 _METHOD_FOR_TOKEN = {
     Tokens.GRV: "grv",
     Tokens.COMMIT: "commit",
@@ -199,7 +202,9 @@ class Database:
         self.client.spawn(self._watch_actor(key, out))
         return out
 
-    async def _watch_actor(self, key: bytes, out, baseline_version=None) -> None:
+    async def _watch_actor(
+        self, key: bytes, out, baseline_version=None, baseline_value=_NO_VALUE
+    ) -> None:
         """Register (and keep re-registering across failovers/moves) a
         storage watch; resolve `out` with the new value.
 
@@ -209,13 +214,20 @@ class Database:
         transaction saw). Reading it at a fresh version instead silently
         adopted any change that landed in between as the new baseline,
         and the watch then never fired for it (a permanent lost wakeup —
-        found by the Watches workload in the chaos soak)."""
+        found by the Watches workload in the chaos soak).
+
+        ``baseline_value``: overrides the baseline read entirely — the
+        set-then-watch pattern: when the watching transaction itself
+        WROTE the key, the baseline is the value it wrote, not the
+        pre-write value at its read version (which would fire the watch
+        immediately and spuriously, turning watch loops into busy
+        polls)."""
         from ..errors import FdbError, TransactionTooOld
         from ..server.interfaces import Tokens as T
         from ..server.interfaces import WatchValueRequest
 
-        baseline_known = False
-        v0 = None
+        baseline_known = baseline_value is not _NO_VALUE
+        v0 = None if not baseline_known else baseline_value
         while not out.is_ready():
             try:
                 tr = self.transaction()

@@ -365,13 +365,35 @@ class Transaction:
         return reply.version
 
     def _start_watches(self) -> None:
+        from .database import _NO_VALUE
+
         for key, fut in self._watches:
-            # the baseline is THIS transaction's read version (when it
-            # read anything): the watch fires on change from what this
-            # transaction saw, not from some later state
+            # the baseline is what THIS transaction established: the value
+            # it WROTE when it wrote the key (set-then-watch must not fire
+            # on the transaction's own write), else what it could have SEEN
+            # at its read version
+            baseline_value = _NO_VALUE
+            w = self._writes.get(key)
+            if w is not None and w[0] in ("value", "value_db"):
+                baseline_value = w[1]
+            elif w is None and key not in self._unreadable and self._cleared[key]:
+                baseline_value = None
+            elif w is not None or key in self._unreadable:
+                # written, but the value is only known server-side (an
+                # undetermined atomic chain, or a versionstamped value) —
+                # read the baseline back at the commit version
+                self.db.client.spawn(
+                    self.db._watch_actor(
+                        key, fut, baseline_version=self.committed_version
+                    )
+                )
+                continue
             self.db.client.spawn(
                 self.db._watch_actor(
-                    key, fut, baseline_version=self._read_version
+                    key,
+                    fut,
+                    baseline_version=self._read_version,
+                    baseline_value=baseline_value,
                 )
             )
         self._watches = []

@@ -15,6 +15,12 @@ class Knobs:
     COMMIT_BATCH_INTERVAL_FROM_IDLE = 0.0005  # first batch after idle
     MAX_COMMIT_BATCH_INTERVAL = 0.25  # idle proxies commit empty batches
     MAX_BATCH_TXNS = 4096
+    # bound on phase-1's wait for the master's version grant: past this the
+    # request is presumed dropped (partition) and the batch errors as
+    # commit_unknown_result instead of wedging the gate chain. Sized past
+    # the master's 4s gap-abandonment window so a merely-slow grant that
+    # the master still honors isn't double-assigned.
+    GETCOMMITVERSION_TIMEOUT = 6.0
     VERSIONS_PER_SECOND = 1_000_000
     MAX_READ_TRANSACTION_LIFE_VERSIONS = 5_000_000  # the MVCC window (~5s)
     MAX_VERSIONS_IN_FLIGHT = 100_000_000

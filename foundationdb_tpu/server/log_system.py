@@ -114,6 +114,18 @@ def assign_tags(
 # -- proxy side: push ----------------------------------------------------------
 
 
+async def settle_bounded(futs: list, seconds: float) -> list[bool]:
+    """Await up to `seconds` (one shared deadline) for each future to
+    settle; returns a per-future success flag (settled without error).
+    Dropped requests never settle at all — this bounds them."""
+    deadline = delay(seconds)
+    ok = []
+    for fut in futs:
+        which = await wait_for_any([settled(fut), deadline])
+        ok.append(which == 0 and not fut.is_error())
+    return ok
+
+
 class LogSystem:
     """The proxy's handle on the current tlog generation (ILogSystem::push)."""
 
@@ -154,6 +166,25 @@ class LogSystem:
             )
         await wait_for_all(pushes)
 
+    async def confirm_live(self, process) -> None:
+        """Prove this epoch has not ended (confirmEpochLive,
+        TagPartitionedLogSystem.actor.cpp:456): recovery must lock at least
+        one replica of EVERY tag before it can determine the epoch end, so
+        if every replica of ANY single tag confirms it is unlocked, no
+        newer epoch can have acked a commit before those replies were sent.
+        Raises BrokenPromise when no tag can fully confirm (epoch fenced or
+        tlogs unreachable) — the caller errors its GRV batch and clients
+        retry against the next epoch's proxies."""
+        logs = self.tlog_set.logs
+        futs = [process.request(l.ep("confirmRunning"), None) for l in logs]
+        flags = await settle_bounded(futs, 1.0)
+        ok = {l.log_id for l, good in zip(logs, flags) if good}
+        all_tags = {t for log in self.tlog_set.logs for t in log.tags}
+        for t in all_tags:
+            if all(l.log_id in ok for l in self.tlog_set.logs_for_tag(t)):
+                return
+        raise BrokenPromise("epoch not live: no tag fully confirmed running")
+
 
 # -- recovery side: lock -------------------------------------------------------
 
@@ -174,12 +205,10 @@ async def lock_tlog_set(
             process.request(l.ep("lock"), TLogLockRequest(epoch=epoch))
             for l in pending
         ]
-        deadline = delay(timeout_per_try)
-        for log, fut in zip(pending, futs):
-            which = await wait_for_any([settled(fut), deadline])
-            if which == 1 or fut.is_error():
-                continue
-            locked[log.log_id] = fut.get()
+        flags = await settle_bounded(futs, timeout_per_try)
+        for log, fut, good in zip(pending, futs, flags):
+            if good:
+                locked[log.log_id] = fut.get()
         all_tags = {t for log in tlog_set.logs for t in log.tags}
         covered = all(
             any(l.log_id in locked for l in tlog_set.logs_for_tag(t))

@@ -260,9 +260,17 @@ class Proxy:
         lowers the answer — we keep asking until it answers or this
         epoch dies (brokenPromiseToNever, :885)."""
         if not self.peers:
+            # the master round trip alone is not enough: a deposed master
+            # keeps answering getLiveCommitted below the new epoch's acked
+            # commits — confirm tlog liveness concurrently, same as the
+            # peer-vote path below
+            confirm = self.process.spawn(
+                self.log_system.confirm_live(self.process)
+            )
             live = await self.process.request(
                 self.master.ep("getLiveCommitted"), None
             )
+            await confirm
             return max(live.version, self.committed_version)
 
         async def peer_version(address, uid):
@@ -274,8 +282,7 @@ class Proxy:
             # Each attempt is itself timed out: a PARTITIONED network drops
             # the request on the floor (net/sim.py) and the reply future
             # would otherwise never resolve at all.
-            deadline = self.knobs.FAILURE_TIMEOUT * 3
-            waited = 0.0
+            deadline = now() + self.knobs.FAILURE_TIMEOUT * 3
             while True:
                 self._check_alive()
                 try:
@@ -290,17 +297,26 @@ class Proxy:
                         return r
                 except BrokenPromise:
                     pass
-                if waited >= deadline:
+                # elapsed-time budget (not per-iteration increments): a peer
+                # that answers instantly with BrokenPromise mid-restart must
+                # not burn the whole budget in a few fast loop turns
+                if now() >= deadline:
                     raise BrokenPromise(f"proxy peer {uid} unreachable")
                 await delay(0.05)
-                waited += 1.05
 
+        # epoch-liveness confirm (confirmEpochLive) rides CONCURRENTLY with
+        # the peer round trip: after a recovering master locks this epoch's
+        # tlogs, peer-confirmed GRVs among the old proxies could otherwise
+        # hand out a read version below a commit the NEW epoch already
+        # acked. One extra message round, zero extra latency.
+        confirm = self.process.spawn(self.log_system.confirm_live(self.process))
         votes = await wait_for_all(
             [
                 self.process.spawn(peer_version(a, u))
                 for a, u in self.peers
             ]
         )
+        await confirm
         return max([self.committed_version, *votes])
 
     async def rate_poller(self):
@@ -418,8 +434,14 @@ class Proxy:
         self._local_batch += 1
         local_n = self._local_batch
         vfut = self._fire_gcv()
+        # the version-grant deadline anchors HERE (request submission), not
+        # at phase-1 entry: phase 1 is serialized by the resolving gate, so
+        # a deadline that started there would make a queue of doomed
+        # batches (partition ate their requests) fail one full timeout at a
+        # time instead of draining promptly
+        vdeadline = now() + self.knobs.GETCOMMITVERSION_TIMEOUT
         try:
-            await self._commit_batch(batch, local_n, vfut)
+            await self._commit_batch(batch, local_n, vfut, vdeadline)
         except TLogStopped as e:
             # this epoch is over: a recovering master locked our tlogs.
             # EXPECTED end-of-life, not an actor crash — re-raising would
@@ -452,6 +474,12 @@ class Proxy:
             for f in replies:
                 if not f.is_ready():
                     f._set_error(e)
+            # release the ordered-phase gates BEFORE the master-alive probe
+            # below: a doomed-batch queue must drain at probe-free speed,
+            # not serialize one probe timeout per batch (finally{} still
+            # covers every other exit path)
+            self._resolving_gate.advance_to(local_n)
+            self._logging_gate.advance_to(local_n)
             from ..runtime.loop import Cancelled
             from ..runtime.trace import SevWarn, trace
 
@@ -465,7 +493,23 @@ class Proxy:
                 Err=repr(e),
             )
             if isinstance(e, BrokenPromise) and "master" in str(e):
-                self._master_misses += 1
+                # only count toward master-gone if the master is
+                # unreachable NOW: a healed partition leaves a queue of
+                # doomed batches whose version requests it ate, and their
+                # drain must not kill a proxy whose master is back
+                try:
+                    alive = await timeout(
+                        self.process.request(
+                            self.master.ep("getLiveCommitted"), None
+                        ),
+                        1.0,
+                    )
+                except BrokenPromise:
+                    alive = None
+                if alive is not None:
+                    self._master_misses = 0
+                else:
+                    self._master_misses += 1
                 if self._master_misses >= 8:
                     trace(
                         SevWarn,
@@ -482,7 +526,7 @@ class Proxy:
             self._resolving_gate.advance_to(local_n)
             self._logging_gate.advance_to(local_n)
 
-    async def _commit_batch(self, batch, local_n, vfut):
+    async def _commit_batch(self, batch, local_n, vfut, vdeadline):
         txns = [t for t, _ in batch]
         replies = [f for _, f in batch]
 
@@ -493,7 +537,20 @@ class Proxy:
         t_p1 = now()
         await self._resolving_gate.wait_until(local_n - 1)
         try:
-            vreq = await vfut
+            # bounded: a getCommitVersion request dropped by a partition
+            # never resolves (the sim net drops it on the floor), and the
+            # master's gap-abandonment assumes the proxy's batch fails on
+            # its own. Without this timeout the batch hangs at vfut forever
+            # and every successor wedges on _resolving_gate.
+            vreq = (
+                await timeout(vfut, vdeadline - now())
+                if vdeadline > now()
+                else (vfut.get() if vfut.is_ready() and not vfut.is_error() else None)
+            )
+            if vreq is None:
+                raise BrokenPromise(
+                    "master getCommitVersion lost (request or reply dropped)"
+                )
             prev_version, version = vreq.prev_version, vreq.version
             resolve_futs, resolve_meta = self._send_resolve(
                 prev_version, version, txns

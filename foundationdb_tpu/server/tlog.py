@@ -235,6 +235,18 @@ class TLog:
         self._maybe_spill()
         return None
 
+    async def confirmRunning(self, _req) -> bool:
+        """GRV-path epoch-liveness probe (the reference's confirmEpochLive,
+        TagPartitionedLogSystem.actor.cpp confirmEpochLive → tlog
+        TLogConfirmRunningRequest): errors once a higher-epoch master has
+        fenced this tlog, so old-epoch proxies stop answering GRVs from
+        stale peer-confirmed state."""
+        if self.stopped:
+            raise TLogStopped(
+                f"tlog {self.log_id} locked at {self.locked_by_epoch}"
+            )
+        return True
+
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:
         """Fence this tlog for recovery by a higher epoch (tLogLock:467)."""
         if req.epoch > self.epoch and req.epoch > self.locked_by_epoch:
@@ -461,6 +473,7 @@ class TLog:
         process.register(f"tlog.peek#{self.log_id}", self.peek)
         process.register(f"tlog.pop#{self.log_id}", self.pop)
         process.register(f"tlog.lock#{self.log_id}", self.lock)
+        process.register(f"tlog.confirmRunning#{self.log_id}", self.confirmRunning)
         process.register(f"tlog.ping#{self.log_id}", _pong)
         process.register(f"tlog.metrics#{self.log_id}", self._metrics)
 

@@ -61,6 +61,7 @@ class _Child:
         self.delay = restart_delay
         self.proc: subprocess.Popen = None
         self.started_at = 0.0
+        self.restart_at: float = None  # pending-restart deadline
 
     def start(self):
         self.proc = subprocess.Popen(
@@ -72,6 +73,15 @@ class _Child:
         )
 
     def poll_and_restart(self):
+        # deadline-based, never sleeps: one child's 60s backoff must not
+        # stall restarts of the other children or signal handling for the
+        # whole window (the monitor loop stays responsive at poll period)
+        if self.restart_at is not None:
+            if time.time() >= self.restart_at:
+                self.restart_at = None
+                self.delay = min(self.delay * 2, 60.0)
+                self.start()
+            return
         if self.proc.poll() is None:
             return
         rc = self.proc.returncode
@@ -84,9 +94,7 @@ class _Child:
             f"restarting in {self.delay:.1f}s",
             flush=True,
         )
-        time.sleep(self.delay)
-        self.delay = min(self.delay * 2, 60.0)
-        self.start()
+        self.restart_at = time.time() + self.delay
 
     def stop(self, sig=signal.SIGTERM):
         if self.proc and self.proc.poll() is None:

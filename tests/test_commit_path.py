@@ -488,3 +488,38 @@ def test_grv_batching_coalesces_rpcs():
         return True
 
     assert drive(sim, go())
+
+
+def test_partitioned_getcommitversion_does_not_wedge_proxy():
+    """A partition that eats the proxy's getCommitVersion request must
+    error that batch (commit_unknown_result), not hang it at vfut forever
+    — a wedged batch blocks every successor on _resolving_gate while GRVs
+    keep succeeding (ADVICE r4 medium). After healing, commits flow again."""
+    from foundationdb_tpu.errors import CommitUnknownResult
+
+    sim, cluster, db = make_db(seed=21, n_proxies=1)
+
+    async def go():
+        # healthy commit first (warms client caches)
+        tr = db.transaction()
+        tr.set(b"a", b"1")
+        await tr.commit()
+
+        sim.partition("proxy0", "master")
+        tr = db.transaction()
+        tr.set(b"b", b"2")
+        # the commit must RESOLVE (with commit_unknown_result) before the
+        # drive limit — the bug was an eternal hang at vfut
+        with pytest.raises(CommitUnknownResult):
+            await tr.commit()
+
+        sim.heal()
+        tr = db.transaction()
+        tr.set(b"c", b"3")
+        v = await tr.commit()
+        assert v > 0
+        tr2 = db.transaction()
+        assert await tr2.get(b"c") == b"3"
+        return True
+
+    assert drive(sim, go(), limit=300.0)

@@ -172,6 +172,63 @@ def test_transaction_watch_after_commit():
     drive(sim, body())
 
 
+def test_set_then_watch_baseline_is_written_value():
+    """A transaction that READS (pinning its read version), then SETS the
+    watched key, then watches it: the baseline must be the value the
+    transaction WROTE, not the pre-write value at its read version —
+    otherwise every set-then-watch registration fires immediately and
+    spuriously (watch loops become busy polls). ADVICE r4 finding."""
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def setup(tr):
+            tr.set(b"other", b"x")
+
+        await db.run(setup)
+
+        tr = db.transaction()
+        await tr.get(b"other")  # pins _read_version before the write
+        tr.set(b"k", b"mine")
+        w = tr.watch(b"k")
+        await tr.commit()
+        await delay(0.5)
+        assert not w.is_ready(), "watch fired on the watcher's own write"
+
+        async def change(tr2):
+            tr2.set(b"k", b"theirs")
+
+        await db.run(change)
+        assert await timeout(w, 10.0, default="TIMEOUT") == b"theirs"
+
+    drive(sim, body())
+
+
+def test_clear_then_watch_does_not_fire_on_own_clear():
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def setup(tr):
+            tr.set(b"c", b"x")
+
+        await db.run(setup)
+
+        tr = db.transaction()
+        await tr.get(b"c")
+        tr.clear(b"c")
+        w = tr.watch(b"c")
+        await tr.commit()
+        await delay(0.5)
+        assert not w.is_ready(), "watch fired on the watcher's own clear"
+
+        async def change(tr2):
+            tr2.set(b"c", b"back")
+
+        await db.run(change)
+        assert await timeout(w, 10.0, default="TIMEOUT") == b"back"
+
+    drive(sim, body())
+
+
 def test_watch_on_clear_fires_with_none():
     sim, cluster, db = make_db()
 
