@@ -374,14 +374,16 @@ class Transaction:
             # at its read version
             baseline_value = _NO_VALUE
             w = self._writes.get(key)
-            if w is not None and w[0] in ("value", "value_db"):
+            if w is not None and w[0] == "value":
                 baseline_value = w[1]
             elif w is None and key not in self._unreadable and self._cleared[key]:
                 baseline_value = None
             elif w is not None or key in self._unreadable:
-                # written, but the value is only known server-side (an
-                # undetermined atomic chain, or a versionstamped value) —
-                # read the baseline back at the commit version
+                # written, but the committed value is only known
+                # server-side (an undetermined atomic chain, a chain
+                # collapsed over a SNAPSHOT read whose base may have moved
+                # without conflicting ("value_db"), or a versionstamped
+                # value) — read the baseline back at the commit version
                 self.db.client.spawn(
                     self.db._watch_actor(
                         key, fut, baseline_version=self.committed_version

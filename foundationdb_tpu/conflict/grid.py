@@ -68,6 +68,10 @@ SENTINEL = jnp.uint32(0xFFFFFFFF)
 
 COMMITTED, CONFLICT, TOO_OLD = 0, 1, 2
 
+# offset keeping packed staged-event payloads nonnegative (|ev| ≤ 2·W·KW
+# per batch, far below 2^20); see merge_writes' packed sort operand
+_EV_OFF = 1 << 20
+
 
 class GridState(NamedTuple):
     pivots: jax.Array  # uint32[B, L]; unused buckets = all-0xFF
@@ -180,6 +184,25 @@ def _rank_lt(points: jax.Array, pivots: jax.Array) -> jax.Array:
     return s1 * B2 + s2
 
 
+def _rank_le_lt(pa: jax.Array, pe: jax.Array, pivots: jax.Array):
+    """(rank_le(pa), rank_lt(pe)) with ONE fused second-level block gather
+    instead of two — the gather is descriptor-bound, so halving the
+    dispatches matters while the extra compare lanes are nearly free."""
+    B = pivots.shape[0]
+    B1, B2 = _split_factors(B)
+    if B2 == 1:
+        return _rank_le(pa, pivots), _rank_lt(pe, pivots)
+    Q = pa.shape[0]
+    pb = pivots.reshape(B1, B2, pivots.shape[-1])
+    sup = pb[:, 0, :]
+    s1a = lex_le(sup[None], pa[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    s1e = lex_lt(sup[None], pe[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    blk = pb[jnp.maximum(jnp.concatenate([s1a, s1e]), 0)]  # [2Q, B2, L]
+    s2a = lex_le(blk[:Q], pa[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    s2e = lex_lt(blk[Q:], pe[:, None, :]).sum(axis=-1, dtype=jnp.int32) - 1
+    return s1a * B2 + s2a, s1e * B2 + s2e
+
+
 # ---------------------------------------------------------------------------
 # Phase 1: history check
 
@@ -193,11 +216,21 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
     active = lex_lt(a, e)
     snap = jnp.repeat(batch.t_snap, KR)
 
-    ba = _rank_le(a, state.pivots)  # bucket containing a
-    be = _rank_lt(e, state.pivots)  # bucket containing e⁻
+    # bucket containing a / bucket containing e⁻, one fused rank pass
+    ba, be = _rank_le_lt(a, e, state.pivots)
 
-    win_a = state.grid[jnp.maximum(ba, 0)]  # [Q, S, L+1] block gather
-    used_a = jnp.arange(S)[None, :] < state.count[jnp.maximum(ba, 0)][:, None]
+    # ONE fused block gather serves both endpoints' bucket windows (and
+    # their counts): half the gather dispatches of the
+    # separate win_a/win_e form — gathers here are descriptor-bound, not
+    # byte-bound, so fewer launches is the lever (BENCH_NOTES r4 attack
+    # list: "fuse the history-check bucket gathers")
+    Q = a.shape[0]
+    idx = jnp.concatenate([jnp.maximum(ba, 0), jnp.maximum(be, 0)])
+    win = state.grid[idx]  # [2Q, S, L+1] block gather
+    cnt = state.count[idx]
+    used = jnp.arange(S)[None, :] < cnt[:, None]
+    win_a, win_e = win[:Q], win[Q:]
+    used_a, used_e = used[:Q], used[Q:]
     bnd_a = win_a[..., :L]
     ver_a = win_a[..., L].astype(jnp.int32)
 
@@ -217,8 +250,6 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
 
     # e's bucket (when different): gaps starting before e
     diff = be > ba
-    win_e = state.grid[jnp.maximum(be, 0)]
-    used_e = jnp.arange(S)[None, :] < state.count[jnp.maximum(be, 0)][:, None]
     bnd_e = win_e[..., :L]
     ver_e = win_e[..., L].astype(jnp.int32)
     in_e = used_e & lex_lt(bnd_e, e[:, None, :])
@@ -237,11 +268,11 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
     full_sup = (ar1 > s1a[:, None]) & (ar1 < s1e[:, None])
     v_sup = jnp.max(jnp.where(full_sup, bmax_sup[None, :], 0), axis=1)
     ar2 = jnp.arange(B2, dtype=jnp.int32)[None, :]
-    blk_a = bmax_blk[jnp.maximum(s1a, 0)]  # [Q, B2]
+    blk = bmax_blk[jnp.concatenate([jnp.maximum(s1a, 0), jnp.maximum(s1e, 0)])]
+    blk_a, blk_e = blk[:Q], blk[Q:]  # fused [2Q, B2] block gather
     hi2 = jnp.where(s1e == s1a, s2e, B2)
     in_a = (ar2 > s2a[:, None]) & (ar2 < hi2[:, None])
     v_edge_a = jnp.max(jnp.where(in_a, blk_a, 0), axis=1)
-    blk_e = bmax_blk[jnp.maximum(s1e, 0)]
     in_e = (s1e > s1a)[:, None] & (ar2 < s2e[:, None])
     v_edge_e = jnp.max(jnp.where(in_e, blk_e, 0), axis=1)
     v_btw = jnp.maximum(v_sup, jnp.maximum(v_edge_a, v_edge_e))
@@ -249,8 +280,9 @@ def history_conflicts(state: GridState, batch: Batch) -> jax.Array:
     # bucket floors: the gap containing a (always overlapped) carries at
     # least floor[ba]; when e⁻ lands in a later bucket its pivot gap
     # starts before e, so floor[be] applies too
-    fl_a = state.floor[jnp.maximum(ba, 0)]
-    fl_e = jnp.where(diff, state.floor[jnp.maximum(be, 0)], 0)
+    fl = state.floor[idx]
+    fl_a = fl[:Q]
+    fl_e = jnp.where(diff, fl[Q:], 0)
 
     vmax = jnp.maximum(jnp.maximum(v_at_a, v_in_a), jnp.maximum(v_in_e, v_btw))
     vmax = jnp.maximum(vmax, jnp.maximum(fl_a, fl_e))
@@ -401,8 +433,9 @@ def merge_writes(
     ok = w_ok.reshape(Wtot)
     okok = jnp.concatenate([ok, ok])
 
-    bc = _rank_le(c, state.pivots)
-    bd = _rank_le(d, state.pivots)
+    # one fused rank pass for both write endpoints (same comparator)
+    bcd = _rank_le(jnp.concatenate([c, d]), state.pivots)
+    bc, bd = bcd[:Wtot], bcd[Wtot:]
 
     # staged rows: (code, ev) — begins carry +1, ends -1; invalid rows get
     # sentinel codes so they sort last
@@ -507,21 +540,29 @@ def merge_writes(
 
     M = S + S2
     m_code = jnp.concatenate([old_code, st_code], axis=1)  # [U, M, L]
-    m_ver = jnp.concatenate([old_ver, jnp.zeros((U, S2), jnp.int32)], axis=1)
-    m_ev = jnp.concatenate([jnp.zeros((U, S), jnp.int32), st_ev], axis=1)
-    m_old = jnp.concatenate(
-        [old_used.astype(jnp.int32), jnp.zeros((U, S2), jnp.int32)], axis=1
+    # pack (ver, ev, old) into ONE int32 payload: a row is EITHER an old
+    # grid row (ver, old=1, ev=0) or a staged event row (ev, old=0,
+    # ver=0), so bit 0 tags the kind and the rest carries the value.
+    # Versions stay < 2^30 (the host rebases at _INT32_REBASE_THRESHOLD,
+    # tpu_backend.py), so ver << 1 cannot overflow; ev ∈ [-2W, 2W] ≪
+    # _EV_OFF. One payload operand instead of three = a third of the
+    # bitonic sort's non-key traffic (BENCH_NOTES r4 attack list).
+    packed_old = jnp.where(
+        old_used, (old_ver << 1) | 1, jnp.int32(_EV_OFF << 1)
     )
+    packed_st = (st_ev + _EV_OFF) << 1
+    m_pk = jnp.concatenate([packed_old, packed_st], axis=1)
 
     # sort by code only: within an equal-code run the fills/prefix sums
     # below are order-independent (the run-last row sees the full prefix,
     # and at most one old row exists per code)
-    cols = tuple(m_code[..., i] for i in range(L)) + (m_ver, m_ev, m_old)
+    cols = tuple(m_code[..., i] for i in range(L)) + (m_pk,)
     sorted_cols = jax.lax.sort(cols, dimension=1, num_keys=L)
     g_code = jnp.stack(sorted_cols[:L], axis=-1)  # [U, M, L]
-    g_ver = sorted_cols[L]
-    g_ev = sorted_cols[L + 1]
-    g_old = sorted_cols[L + 2].astype(bool)
+    g_pk = sorted_cols[L]
+    g_old = (g_pk & 1) == 1
+    g_ver = jnp.where(g_old, g_pk >> 1, 0)
+    g_ev = jnp.where(g_old, 0, (g_pk >> 1) - _EV_OFF)
 
     # forward-fill gap base values from old rows
     base = _log_shift_fill(jnp.where(g_old, g_ver, 0), g_old)

@@ -177,13 +177,33 @@ class LogSystem:
         retry against the next epoch's proxies."""
         logs = self.tlog_set.logs
         futs = [process.request(l.ep("confirmRunning"), None) for l in logs]
-        flags = await settle_bounded(futs, 1.0)
-        ok = {l.log_id for l, good in zip(logs, flags) if good}
-        all_tags = {t for log in self.tlog_set.logs for t in log.tags}
-        for t in all_tags:
-            if all(l.log_id in ok for l in self.tlog_set.logs_for_tag(t)):
+        members = {}  # tag -> replica indices
+        for i, log in enumerate(logs):
+            for t in log.tags:
+                members.setdefault(t, []).append(i)
+        deadline = delay(1.0)
+        ok: set = set()
+        bad: set = set()
+        while True:
+            # return the moment ANY tag fully confirms — one slow or dead
+            # tlog must not tax every GRV batch with the full deadline
+            if any(all(i in ok for i in m) for m in members.values()):
                 return
-        raise BrokenPromise("epoch not live: no tag fully confirmed running")
+            # fail fast once no tag CAN fully confirm anymore
+            if not any(
+                all(i not in bad for i in m) for m in members.values()
+            ):
+                raise BrokenPromise(
+                    "epoch not live: no tag fully confirmed running"
+                )
+            pending = [i for i in range(len(futs)) if i not in ok | bad]
+            which = await wait_for_any(
+                [settled(futs[i]) for i in pending] + [deadline]
+            )
+            if which == len(pending):
+                raise BrokenPromise("epoch not live: confirm timed out")
+            i = pending[which]
+            (bad if futs[i].is_error() else ok).add(i)
 
 
 # -- recovery side: lock -------------------------------------------------------

@@ -267,10 +267,14 @@ class Proxy:
             confirm = self.process.spawn(
                 self.log_system.confirm_live(self.process)
             )
-            live = await self.process.request(
-                self.master.ep("getLiveCommitted"), None
-            )
-            await confirm
+            try:
+                live = await self.process.request(
+                    self.master.ep("getLiveCommitted"), None
+                )
+                await confirm
+            except BaseException:
+                confirm.cancel()  # don't orphan the confirm actor
+                raise
             return max(live.version, self.committed_version)
 
         async def peer_version(address, uid):
@@ -310,13 +314,17 @@ class Proxy:
         # hand out a read version below a commit the NEW epoch already
         # acked. One extra message round, zero extra latency.
         confirm = self.process.spawn(self.log_system.confirm_live(self.process))
-        votes = await wait_for_all(
-            [
-                self.process.spawn(peer_version(a, u))
-                for a, u in self.peers
-            ]
-        )
-        await confirm
+        try:
+            votes = await wait_for_all(
+                [
+                    self.process.spawn(peer_version(a, u))
+                    for a, u in self.peers
+                ]
+            )
+            await confirm
+        except BaseException:
+            confirm.cancel()  # don't orphan the confirm actor
+            raise
         return max([self.committed_version, *votes])
 
     async def rate_poller(self):
@@ -526,6 +534,33 @@ class Proxy:
             self._resolving_gate.advance_to(local_n)
             self._logging_gate.advance_to(local_n)
 
+    async def _plug_version_hole(self, vfut):
+        """A batch abandoned its version grant at the deadline, but the
+        grant may arrive late (the request was delivered; only the reply
+        was slow or lost). The master has chained later versions onto the
+        granted one, so the chain hole MUST be filled — push an empty
+        batch at exactly that (prev, version) through resolvers and tlogs,
+        which order by prev_version chaining on their own. If the grant
+        never arrives, no version was assigned and there is no hole."""
+        try:
+            vreq = await vfut
+        except Exception:
+            return  # request truly lost: the master assigned nothing
+        try:
+            resolve_futs, _meta = self._send_resolve(
+                vreq.prev_version, vreq.version, []
+            )
+            await wait_for_all(resolve_futs)
+            await self.log_system.push(
+                self.process,
+                vreq.prev_version,
+                vreq.version,
+                {},
+                known_committed=self.committed_version,
+            )
+        except Exception:
+            pass  # epoch is ending; recovery fences and fills the chain
+
     async def _commit_batch(self, batch, local_n, vfut, vdeadline):
         txns = [t for t, _ in batch]
         replies = [f for _, f in batch]
@@ -541,13 +576,17 @@ class Proxy:
             # never resolves (the sim net drops it on the floor), and the
             # master's gap-abandonment assumes the proxy's batch fails on
             # its own. Without this timeout the batch hangs at vfut forever
-            # and every successor wedges on _resolving_gate.
-            vreq = (
-                await timeout(vfut, vdeadline - now())
-                if vdeadline > now()
-                else (vfut.get() if vfut.is_ready() and not vfut.is_error() else None)
-            )
+            # and every successor wedges on _resolving_gate. (A zero-or-
+            # negative remaining budget still propagates a settled vfut's
+            # real error instead of fabricating one.)
+            vreq = await timeout(vfut, max(0.0, vdeadline - now()))
             if vreq is None:
+                # the grant may still arrive LATE (request delivered, reply
+                # slow or eaten): if it ever does, the master has chained
+                # later versions onto it, and an unfilled hole in the
+                # prev->version chain wedges every subsequent batch at the
+                # resolvers/tlogs forever. Leave a continuation to plug it.
+                self.process.spawn(self._plug_version_hole(vfut))
                 raise BrokenPromise(
                     "master getCommitVersion lost (request or reply dropped)"
                 )
@@ -739,7 +778,9 @@ class Proxy:
                 )
             )
             meta.append(idxs)
-        self.last_resolver_versions = version
+        # monotonic: a late hole-plug must not regress the frontier that
+        # normal (gate-ordered) batches advanced past it
+        self.last_resolver_versions = max(self.last_resolver_versions, version)
         return reqs, meta
 
     def _apply_state_mutations(self, resolutions, version):

@@ -523,3 +523,44 @@ def test_partitioned_getcommitversion_does_not_wedge_proxy():
         return True
 
     assert drive(sim, go(), limit=300.0)
+
+
+def test_late_version_grant_plugs_chain_hole():
+    """A version grant that arrives AFTER the proxy abandoned its batch
+    (clogged link: request delivered, reply late) has later versions
+    chained onto it by the master — the abandoned batch must still fill
+    its slot in the prev->version chain (empty push) or every subsequent
+    commit wedges at the resolvers/tlogs forever."""
+    from foundationdb_tpu.errors import CommitUnknownResult
+
+    sim, cluster, db = make_db(seed=23, n_proxies=1)
+
+    async def go():
+        tr = db.transaction()
+        tr.set(b"a", b"1")
+        await tr.commit()
+
+        # longer than GETCOMMITVERSION_TIMEOUT: grants for the batches
+        # fired early in the clog arrive only after their deadlines
+        # expired (but short enough that the proxy's master-gone detector
+        # doesn't — correctly — declare the master dead)
+        sim.clog_pair("proxy0", "master", 7.5)
+        tr = db.transaction()
+        tr.set(b"b", b"2")
+        try:
+            await tr.commit()
+        except CommitUnknownResult:
+            pass
+
+        # after the clog drains, new commits must flow — they chain onto
+        # the late-granted versions, which only works if the holes were
+        # plugged
+        tr = db.transaction()
+        tr.set(b"c", b"3")
+        v = await tr.commit()
+        assert v > 0
+        tr2 = db.transaction()
+        assert await tr2.get(b"c") == b"3"
+        return True
+
+    assert drive(sim, go(), limit=300.0)
