@@ -180,6 +180,9 @@ class Proxy:
         self.uid = uid
         self.committed_version: Version = recovery_version
         self.last_resolver_versions: Version = recovery_version
+        # highest version whose resolver state echoes this proxy has
+        # APPLIED (phase 3) — the only receipt a hole-plug may claim
+        self._state_applied: Version = recovery_version
         self.failed = False
         self.process = None
         self._batch: list[tuple[TransactionData, Future]] = []
@@ -547,10 +550,30 @@ class Proxy:
         except Exception:
             return  # request truly lost: the master assigned nothing
         try:
-            resolve_futs, _meta = self._send_resolve(
-                vreq.prev_version, vreq.version, []
-            )
-            await wait_for_all(resolve_futs)
+            # built DIRECTLY, not via _send_resolve: the plug must neither
+            # advance last_resolver_versions (the next real batch still
+            # needs the echo window covering this version — the plug
+            # discards its own echoes) nor claim receipt of state echoes
+            # beyond what phase 3 actually applied (an overclaim lets the
+            # resolver retire state txns another in-flight reply needs)
+            lrv = min(self._state_applied, vreq.prev_version)
+            futs = [
+                self.process.request(
+                    iface.ep("resolve"),
+                    ResolveBatchRequest(
+                        prev_version=vreq.prev_version,
+                        version=vreq.version,
+                        last_receive_version=lrv,
+                        requesting_proxy=(
+                            f"{self.process.address}#{self.uid}"
+                        ),
+                        transactions=[],
+                        state_txn_indices=[],
+                    ),
+                )
+                for _b, _e, iface in self.resolver_map.ranges()
+            ]
+            await wait_for_all(futs)
             await self.log_system.push(
                 self.process,
                 vreq.prev_version,
@@ -615,6 +638,7 @@ class Proxy:
         await self._logging_gate.wait_until(local_n - 1)
         try:
             plan = self._apply_state_mutations(resolutions, version)
+            self._state_applied = max(self._state_applied, version)
             to_log: dict[int, list[Mutation]] = {}
             stamps: list[bytes] = []
             log_counter = 0  # per-batch ordinal for backup-log keys

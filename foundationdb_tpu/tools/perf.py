@@ -1,0 +1,268 @@
+"""perf: measured throughput/latency for the cluster, sim and real TCP.
+
+The driver behind the repo's analog of the reference's published
+benchmarks (documentation/sphinx/source/benchmarking.rst:22-97). Runs the
+ReadWrite / BulkLoad / Throughput workloads (workloads/readwrite.py)
+against either:
+
+  --mode sim   one in-process simulated cluster (wall-clock = the Python
+               pipeline's own cost; latencies reported in sim time = the
+               protocol's model cost)
+  --mode tcp   a real multi-process TCP cluster (tools/tcp_soak.TcpCluster)
+               with --client-procs parallel OS-process clients
+
+Prints ONE JSON line per run:
+  {"workload": ..., "mode": ..., "ops_per_s": ..., "vs_baseline": ...}
+
+vs_baseline compares against the matching benchmarking.rst row:
+  write (0r+10w, 100 clients) : 46,000 writes/s   (rst:53)
+  read  (10r+0w)              : 305,000 reads/s   (rst:67)
+  90_10 (9r+1w)               : 107,000 ops/s     (rst:83)
+  50_50 (5r+5w)               : 107,000 ops/s     (closest published row)
+  bulkload                    : 46,000 writes/s   (write-rate row)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+PRESETS = {
+    # name: (reads_per_txn, writes_per_txn, baseline_ops_per_s, baseline_metric)
+    "write": (0, 10, 46_000.0, "writes_per_s"),
+    "read": (10, 0, 305_000.0, "reads_per_s"),
+    "90_10": (9, 1, 107_000.0, "ops_per_s"),
+    "50_50": (5, 5, 107_000.0, "ops_per_s"),
+}
+
+
+def run_sim(args) -> dict:
+    # tests/sims must never touch a wedged TPU tunnel (memory: axon)
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..client.database import Database
+    from ..net.sim import Sim
+    from ..runtime.futures import spawn
+    from ..runtime.rng import DeterministicRandom
+    from ..server import Cluster, ClusterConfig
+    from ..workloads import run_workloads
+
+    sim = Sim(seed=args.seed)
+    sim.activate()
+    # benchmark network profile (bench.py's e2e rationale): the published
+    # numbers come from real clusters with ~0.1-0.25 ms hops
+    sim.knobs.SIM_FAST_LATENCY = 0.00025
+    sim.knobs.SIM_MAX_LATENCY = 0.001
+    cluster = Cluster(
+        sim,
+        ClusterConfig(
+            n_proxies=2, n_resolvers=2, conflict_backend=args.backend
+        ),
+    )
+    db = Database(sim, cluster.proxy_addrs)
+    w = make_workload(args, db, DeterministicRandom(args.seed))
+
+    async def go():
+        await run_workloads([w])
+        return True
+
+    sim.run_until_done(spawn(go()), 36000.0)
+    return w.rec.report()
+
+
+def make_workload(args, db, rng, now_fn=None):
+    from ..workloads.readwrite import (
+        BulkLoadWorkload,
+        ReadWriteWorkload,
+        ThroughputWorkload,
+    )
+
+    if args.workload == "bulkload":
+        return BulkLoadWorkload(
+            db,
+            rng,
+            actors=args.actors,
+            txns_per_actor=args.txns,
+            keys_per_txn=args.keys_per_txn,
+            now_fn=now_fn,
+        )
+    r, w, _base, _metric = PRESETS[args.workload]
+    if args.duration > 0:
+        return ThroughputWorkload(
+            db,
+            rng,
+            duration=args.duration,
+            actors=args.actors,
+            reads_per_txn=r,
+            writes_per_txn=w,
+            keyspace=args.keyspace,
+            now_fn=now_fn,
+        )
+    return ReadWriteWorkload(
+        db,
+        rng,
+        actors=args.actors,
+        txns_per_actor=args.txns,
+        reads_per_txn=r,
+        writes_per_txn=w,
+        keyspace=args.keyspace,
+        now_fn=now_fn,
+    )
+
+
+def run_tcp_client(args, coordinators) -> dict:
+    """One OS-process client against a running TCP cluster."""
+    from ..client.database import Database
+    from ..net.tcp import RealWorld
+    from ..runtime.futures import spawn
+    from ..runtime.rng import DeterministicRandom
+    from ..workloads import run_workloads
+
+    world = RealWorld("127.0.0.1:0")
+    world.activate()
+    db = Database.from_coordinators(world, coordinators.split(","))
+    w = make_workload(
+        args, db, DeterministicRandom(args.seed), now_fn=time.perf_counter
+    )
+
+    async def go():
+        await run_workloads([w])
+        return True
+
+    world.run_until_done(spawn(go()), 36000.0)
+    return w.rec.report()
+
+
+def run_tcp(args) -> dict:
+    from .tcp_soak import TcpCluster, fdbcli, wait_for
+
+    with tempfile.TemporaryDirectory(prefix="fdbtpu-perf-") as datadir:
+        cluster = TcpCluster(
+            datadir,
+            config=args.tcp_config,
+            classes=tuple(args.tcp_classes.split(",")),
+        )
+        try:
+            wait_for(
+                lambda: (
+                    fdbcli(cluster.coord, "set perfboot ok", timeout=30)[0]
+                    == 0,
+                    "boot",
+                ),
+                180,
+                "cluster never formed",
+                cluster,
+            )
+            procs = []
+            child_args = [
+                sys.executable,
+                "-m",
+                "foundationdb_tpu.tools.perf",
+                "--workload", args.workload,
+                "--mode", "tcp-client",
+                "--coordinators", cluster.coord,
+                "--actors", str(args.actors),
+                "--txns", str(args.txns),
+                "--keyspace", str(args.keyspace),
+                "--keys-per-txn", str(args.keys_per_txn),
+                "--duration", str(args.duration),
+            ]
+            for p in range(args.client_procs):
+                procs.append(
+                    subprocess.Popen(
+                        child_args + ["--seed", str(args.seed + p)],
+                        stdout=subprocess.PIPE,
+                        text=True,
+                        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                    )
+                )
+            reports = []
+            for p in procs:
+                out, _ = p.communicate(timeout=3600)
+                line = [l for l in out.splitlines() if l.startswith("{")][-1]
+                reports.append(json.loads(line))
+            return aggregate(reports)
+        finally:
+            cluster.stop()
+
+
+def aggregate(reports: list[dict]) -> dict:
+    """Sum rates across concurrent client processes; max the percentiles
+    (conservative)."""
+    out = dict(reports[0])
+    for r in reports[1:]:
+        for k in (
+            "ops", "reads", "writes", "commits", "conflicts",
+            "ops_per_s", "reads_per_s", "writes_per_s", "txn_per_s",
+        ):
+            out[k] = round(out.get(k, 0) + r.get(k, 0), 1)
+        for k in (
+            "read_p50_ms", "read_p95_ms", "commit_p50_ms", "commit_p95_ms",
+            "wall_s",
+        ):
+            out[k] = max(out.get(k, 0), r.get(k, 0))
+    out["client_procs"] = len(reports)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="perf")
+    ap.add_argument(
+        "--workload",
+        default="90_10",
+        choices=[*PRESETS, "bulkload"],
+    )
+    ap.add_argument("--mode", default="sim", choices=["sim", "tcp", "tcp-client"])
+    ap.add_argument("--backend", default="oracle", help="sim conflict backend")
+    ap.add_argument("--actors", type=int, default=20)
+    ap.add_argument("--txns", type=int, default=50)
+    ap.add_argument("--keyspace", type=int, default=10_000)
+    ap.add_argument("--keys-per-txn", type=int, default=50, dest="keys_per_txn")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="> 0: time-bounded ThroughputWorkload")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
+    ap.add_argument("--coordinators", default=None)
+    ap.add_argument(
+        "--tcp-config",
+        default="n_storage=2,replication=1,n_tlogs=1",
+        dest="tcp_config",
+    )
+    ap.add_argument(
+        "--tcp-classes",
+        default="storage,storage,transaction,stateless",
+        dest="tcp_classes",
+    )
+    args = ap.parse_args(argv)
+
+    if args.mode == "sim":
+        report = run_sim(args)
+    elif args.mode == "tcp":
+        report = run_tcp(args)
+    else:
+        report = run_tcp_client(args, args.coordinators)
+
+    if args.workload == "bulkload":
+        base, metric = 46_000.0, "writes_per_s"
+    else:
+        _r, _w, base, metric = PRESETS[args.workload]
+    report["workload"] = args.workload
+    report["mode"] = args.mode
+    report["vs_baseline"] = round(report.get(metric, 0.0) / base, 4)
+    report["baseline_metric"] = metric
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Perf workloads (ReadWrite/BulkLoad/Throughput) — correctness smoke.
+
+The measured numbers come from tools/perf.py runs; these tests pin the
+machinery: workloads complete, counters balance, reports carry sane
+values, and the duration-bounded Throughput variant terminates.
+"""
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.server import Cluster, ClusterConfig
+from foundationdb_tpu.workloads import run_workloads
+from foundationdb_tpu.workloads.readwrite import (
+    BulkLoadWorkload,
+    ReadWriteWorkload,
+    ThroughputWorkload,
+)
+
+
+def make_db(seed=0, **cfg):
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(**cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def drive(sim, w, limit=600.0):
+    async def go():
+        await run_workloads([w])
+        return True
+
+    assert sim.run_until_done(spawn(go()), limit)
+
+
+def test_readwrite_90_10_counters_balance():
+    sim, _c, db = make_db(seed=5)
+    w = ReadWriteWorkload(
+        db,
+        DeterministicRandom(5),
+        actors=5,
+        txns_per_actor=8,
+        reads_per_txn=9,
+        writes_per_txn=1,
+        keyspace=500,
+    )
+    drive(sim, w)
+    rep = w.rec.report()
+    assert rep["commits"] == 5 * 8
+    assert rep["reads"] == rep["commits"] * 9
+    assert rep["writes"] == rep["commits"] * 1
+    assert rep["ops"] == rep["reads"] + rep["writes"]
+    assert rep["ops_per_s"] > 0
+    assert rep["read_p50_ms"] > 0
+    assert rep["commit_p50_ms"] > 0
+
+
+def test_bulkload_ingests_all_keys():
+    sim, _c, db = make_db(seed=6)
+    w = BulkLoadWorkload(
+        db, DeterministicRandom(6), actors=3, txns_per_actor=5, keys_per_txn=20
+    )
+    drive(sim, w)
+    rep = w.rec.report()
+    assert rep["writes"] == 3 * 5 * 20
+
+    async def count():
+        tr = db.transaction()
+        rows = await tr.get_range(b"bulk/", b"bulk0", limit=10_000)
+        return len(rows)
+
+    assert sim.run_until_done(spawn(count()), 60.0) == 3 * 5 * 20
+
+
+def test_throughput_duration_bounded():
+    sim, _c, db = make_db(seed=7)
+    w = ThroughputWorkload(
+        db,
+        DeterministicRandom(7),
+        duration=1.0,
+        ramp=0.2,
+        actors=4,
+        reads_per_txn=2,
+        writes_per_txn=2,
+        keyspace=200,
+    )
+    drive(sim, w)
+    rep = w.rec.report()
+    # steady-state only: the ramp's transactions were reset out
+    assert rep["commits"] > 0
+    assert rep["ops"] == rep["reads"] + rep["writes"]
