@@ -78,6 +78,27 @@ class KeyRangeMap:
             out.append((cb, ce, v))
         return out
 
+    def _split_at(self, key: bytes) -> None:
+        i = self._idx(key)
+        if self._bounds[i] != key:
+            self._bounds.insert(i + 1, key)
+            self._vals.insert(i + 1, self._vals[i])
+
+    def modify(self, begin: bytes, end: Optional[bytes], fn) -> None:
+        """Apply ``fn(old_value) -> new_value`` to every piece of
+        [begin, end), splitting boundaries at begin/end (RangeMap::modify)."""
+        self._split_at(begin)
+        if end is not None:
+            self._split_at(end)
+        lo = bisect.bisect_left(self._bounds, begin)
+        hi = (
+            bisect.bisect_left(self._bounds, end)
+            if end is not None
+            else len(self._bounds)
+        )
+        for i in range(lo, hi):
+            self._vals[i] = fn(self._vals[i])
+
     def coalesce(self) -> None:
         """Merge adjacent ranges with equal values (CoalescedKeyRangeMap)."""
         bounds, vals = [self._bounds[0]], [self._vals[0]]

@@ -44,6 +44,12 @@ class Knobs:
     # failure detection / recovery
     HEARTBEAT_INTERVAL = 0.5
     FAILURE_TIMEOUT = 2.0
+    # resolutionBalancing (masterserver.actor.cpp:896): load-driven moves
+    # of key-range boundaries between resolver roles
+    RESOLUTION_BALANCING_INTERVAL = 1.0  # master poll period (s)
+    RESOLUTION_BALANCE_MIN_OPS = 200  # min per-interval imbalance to act
+    RESOLUTION_BALANCE_RATIO = 1.5  # max/min load ratio that triggers a move
+    RESOLUTION_SAMPLE_KEYS = 4096  # per-resolver load sample cap
     # ratekeeper (admission control by worst storage version lag)
     RK_MAX_TPS = 100_000.0
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)

@@ -144,6 +144,23 @@ class Cluster:
             pr.register(sim.new_process(addr))
             self.proxies.append(pr)
             self.proxy_addrs.append(addr)
+        self.resolver_map = resolver_map
+        self.master_process = p
+        self.balancer = None
+
+    def start_resolution_balancer(self):
+        """Opt-in for the static cluster (the recovery master always runs
+        one): load-driven resolver-boundary moves."""
+        from .resolution_balance import ResolutionBalancer
+
+        self.balancer = ResolutionBalancer(
+            self.knobs,
+            self.resolver_map,
+            self.master,
+            [p.uid for p in self.proxies],
+        )
+        self.master_process.spawn(self.balancer.run(self.master_process))
+        return self.balancer
 
     # -- test/ops helpers ------------------------------------------------------
 

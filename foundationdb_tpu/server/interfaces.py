@@ -46,12 +46,22 @@ class GetCommitVersionRequest:
     # versions in submission order despite network reordering. -1 =
     # unordered legacy caller (assign on arrival).
     request_num: int = -1
+    # highest resolver_changes_version this proxy has applied — the ack
+    # that lets the master stop re-attaching a balancing change set (a
+    # lost grant reply must not lose the delivery)
+    applied_changes_version: Version = 0
 
 
 @dataclass
 class GetCommitVersionReply:
     prev_version: Version = INVALID_VERSION
     version: Version = INVALID_VERSION
+    # resolutionBalancing piggyback (masterserver.actor.cpp:806): boundary
+    # moves [(begin, end, ResolverInterface)] delivered to each proxy with
+    # its first version grant after the master recorded them; they apply
+    # to commit versions >= resolver_changes_version
+    resolver_changes: tuple = ()
+    resolver_changes_version: Version = 0
 
 
 @dataclass

@@ -82,6 +82,29 @@ class Master:
         # invariant (phase 3) breaks
         self._req_seq: dict[str, int] = {}
         self._parked: dict[tuple, object] = {}  # (proxy, num) → Future
+        # resolutionBalancing (masterserver.actor.cpp:216,806): pending
+        # boundary moves, attached to every proxy's version grants until
+        # the proxy ACKS the changes version in a later request — a lost
+        # grant reply must not lose the delivery
+        self._resolver_changes: tuple = ()
+        self._resolver_changes_version: int = 0
+        self._changes_proxy_ids: list = []
+        self._changes_acked: dict[str, int] = {}
+
+    def set_resolver_changes(self, moves, proxy_ids) -> bool:
+        """Record boundary moves [(begin, end, iface)]; they reach every
+        proxy piggybacked on version grants and apply from the next
+        version. Refused (False) while a previous set is still being
+        delivered — the balancer retries next interval."""
+        if self._resolver_changes and any(
+            self._changes_acked.get(p, 0) < self._resolver_changes_version
+            for p in self._changes_proxy_ids
+        ):
+            return False
+        self._changes_proxy_ids = list(proxy_ids)
+        self._resolver_changes = tuple(moves)
+        self._resolver_changes_version = self.last_assigned + 1
+        return True
 
     # -- handlers --------------------------------------------------------------
 
@@ -128,7 +151,22 @@ class Master:
             )
             if nxt is not None:
                 nxt._set(True)  # truthy: distinguishes wake from timeout
-        return GetCommitVersionReply(prev_version=prev, version=self.last_assigned)
+        changes, changes_v = (), 0
+        if req.requesting_proxy:
+            acked = self._changes_acked.get(req.requesting_proxy, 0)
+            if req.applied_changes_version > acked:
+                acked = self._changes_acked[req.requesting_proxy] = (
+                    req.applied_changes_version
+                )
+            if self._resolver_changes and acked < self._resolver_changes_version:
+                changes = self._resolver_changes
+                changes_v = self._resolver_changes_version
+        return GetCommitVersionReply(
+            prev_version=prev,
+            version=self.last_assigned,
+            resolver_changes=changes,
+            resolver_changes_version=changes_v,
+        )
 
     async def report_committed(self, req: ReportRawCommittedVersionRequest):
         if req.version > self.live_committed:
@@ -622,12 +660,18 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
             else []
         )
     )
+    from .resolution_balance import ResolutionBalancer
+
+    balancer = ResolutionBalancer(
+        knobs, resolver_map, master, [i.uid for i in proxy_ifaces]
+    )
     aux = [
         process.spawn(
             _track_tlog_recovery(process, cs, core, info, cc_address, storage)
         ),
         process.spawn(dd.run()),
         process.spawn(rk.run()),
+        process.spawn(balancer.run(process)),
     ]
     try:
         await _wait_failure(process, watched)

@@ -168,7 +168,27 @@ class Proxy:
         peers: list = None,  # [(address, uid)] of ALL the epoch's proxies
     ):
         self.master = master
-        self.resolver_map = resolver_map
+        # keyResolvers (MasterProxyServer.actor.cpp:183): key range →
+        # VERSION HISTORY of owning resolvers, oldest..newest. Balancing
+        # moves append (version, iface) entries delivered with version
+        # grants; during the MVCC transition window reads fan out to every
+        # owner back to their snapshot (each still holds its era's write
+        # history — verdicts stay exact, no fence, no re-route race) and
+        # writes go to the newest owner. Per-proxy (applied at each
+        # proxy's own grant order), hence the copy.
+        self.key_resolvers = KeyRangeMap()
+        self._all_resolvers: list = []
+        seen = set()
+        for b, e, iface in resolver_map.ranges():
+            self.key_resolvers.insert(b, e, ((0, iface),))
+            if (iface.address, iface.uid) not in seen:
+                seen.add((iface.address, iface.uid))
+                self._all_resolvers.append(iface)
+        self._resolver_index = {
+            (i.address, i.uid): n for n, i in enumerate(self._all_resolvers)
+        }
+        self._last_kr_trim = 0.0
+        self._applied_changes_version: Version = 0
         self.log_system = log_system
         if isinstance(shards, ShardMap):
             shards = shards.to_list()
@@ -437,7 +457,11 @@ class Proxy:
         self._gcv_num += 1
         return self.process.request(
             self.master.ep("getCommitVersion"),
-            GetCommitVersionRequest(requesting_proxy=self.uid, request_num=num),
+            GetCommitVersionRequest(
+                requesting_proxy=self.uid,
+                request_num=num,
+                applied_changes_version=self._applied_changes_version,
+            ),
         )
 
     async def commit_batch(self, batch):
@@ -549,6 +573,9 @@ class Proxy:
             vreq = await vfut
         except Exception:
             return  # request truly lost: the master assigned nothing
+        # a late grant can be the carrier of a balancing change set —
+        # apply (idempotent) so the delivery isn't lost with the batch
+        self._apply_resolver_changes(vreq)
         try:
             # built DIRECTLY, not via _send_resolve: the plug must neither
             # advance last_resolver_versions (the next real batch still
@@ -571,7 +598,7 @@ class Proxy:
                         state_txn_indices=[],
                     ),
                 )
-                for _b, _e, iface in self.resolver_map.ranges()
+                for iface in self._all_resolvers
             ]
             await wait_for_all(futs)
             await self.log_system.push(
@@ -613,6 +640,7 @@ class Proxy:
                 raise BrokenPromise(
                     "master getCommitVersion lost (request or reply dropped)"
                 )
+            self._apply_resolver_changes(vreq)
             prev_version, version = vreq.prev_version, vreq.version
             resolve_futs, resolve_meta = self._send_resolve(
                 prev_version, version, txns
@@ -743,29 +771,92 @@ class Proxy:
                 self._c_txn_conflict.add()
                 reply._set_error(NotCommitted())
 
-    def _send_resolve(self, prev_version, version, txns):
-        """ResolutionRequestBuilder (MasterProxyServer.actor.cpp:233): each
-        resolver sees the conflict-range pieces inside its key partition;
-        verdicts combine conservatively (committed iff every involved
-        resolver committed). A system-keyspace txn additionally appears in
-        EVERY resolver's request (state_txn_indices) — its metadata
-        mutations ride on resolver 0's copy — so each resolver can echo it
-        to every proxy with its own verdict (:302-305)."""
-        resolvers = []  # [(iface, begin, end, idxs, datas, state_idxs)]
-        for r_begin, r_end, iface in self.resolver_map.ranges():
-            resolvers.append((iface, r_begin, r_end, [], [], []))
+    def _apply_resolver_changes(self, vreq) -> None:
+        """Boundary moves piggybacked on the version grant
+        (MasterProxyServer.actor.cpp:370): append the new owner to each
+        touched range's version history. Grant order == batch order, so a
+        batch's routing map reflects exactly the changes at versions
+        before its own. Idempotent by changes version: the master
+        re-attaches a set until acked, and several in-flight grants can
+        carry the same one."""
+        cv = vreq.resolver_changes_version
+        if vreq.resolver_changes and cv > self._applied_changes_version:
+            self._applied_changes_version = cv
+            for begin, end, iface in vreq.resolver_changes:
+                self.key_resolvers.modify(
+                    begin, end, lambda owners, i=iface, v=cv: owners + ((v, i),)
+                )
+        # periodic expiry (:847): owners older than the MVCC window below
+        # the newest can no longer be consulted by any live snapshot
+        t = now()
+        if t - self._last_kr_trim > 1.0:
+            self._last_kr_trim = t
+            oldest = (
+                vreq.prev_version
+                - self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
+            )
+            trimmed = KeyRangeMap()
+            for b, e, owners in self.key_resolvers.ranges():
+                os = list(owners)
+                while len(os) > 1 and os[1][0] < oldest:
+                    os.pop(0)
+                if os and os[0][0] < oldest:
+                    os[0] = (0, os[0][1])
+                trimmed.insert(b, e, tuple(os))
+            trimmed.coalesce()
+            self.key_resolvers = trimmed
 
-        single = len(resolvers) == 1
+    def _send_resolve(self, prev_version, version, txns):
+        """ResolutionRequestBuilder (MasterProxyServer.actor.cpp:233):
+        conflict ranges are clipped per keyResolvers range; a READ piece
+        goes to every owner from newest back to the first one older than
+        the txn's snapshot (each era's owner holds that era's write
+        history — together they cover the read exactly), a WRITE piece to
+        the newest owner. Verdicts combine conservatively (committed iff
+        every involved resolver committed). A system-keyspace txn
+        additionally appears in EVERY resolver's request
+        (state_txn_indices) — its metadata mutations ride on resolver 0's
+        copy — so each resolver can echo it to every proxy with its own
+        verdict (:302-305)."""
+        universe = self._all_resolvers
+        index = self._resolver_index
+        # [(iface, idxs, datas, state_idxs)] in fixed epoch order
+        resolvers = [(iface, [], [], []) for iface in universe]
+
+        moving = any(
+            len(owners) > 1 for _b, _e, owners in self.key_resolvers.ranges()
+        )
+        single = len(universe) == 1
         for i, t in enumerate(txns):
             is_state = any(is_metadata_mutation(m) for m in t.mutations)
-            for rn, (iface, r_begin, r_end, idxs, datas, state_idxs) in enumerate(
-                resolvers
-            ):
-                if single:
-                    rcr, wcr = t.read_conflict_ranges, t.write_conflict_ranges
-                else:
-                    rcr = _clip_ranges(t.read_conflict_ranges, r_begin, r_end)
-                    wcr = _clip_ranges(t.write_conflict_ranges, r_begin, r_end)
+            if single:
+                rcr_by = [list(t.read_conflict_ranges)]
+                wcr_by = [list(t.write_conflict_ranges)]
+            else:
+                rcr_by = [[] for _ in universe]
+                wcr_by = [[] for _ in universe]
+                for rb, re_ in t.read_conflict_ranges:
+                    for cb, ce, owners in self.key_resolvers.intersecting(
+                        rb, re_
+                    ):
+                        if not moving:
+                            rcr_by[index[_ikey(owners[-1][1])]].append((cb, ce))
+                            continue
+                        for j in range(len(owners) - 1, -1, -1):
+                            v, iface = owners[j]
+                            rcr_by[index[_ikey(iface)]].append((cb, ce))
+                            if v <= t.read_snapshot:
+                                # this era already covers every write the
+                                # snapshot could conflict with (> snap);
+                                # older eras hold only writes < v
+                                break
+                for wb, we in t.write_conflict_ranges:
+                    for cb, ce, owners in self.key_resolvers.intersecting(
+                        wb, we
+                    ):
+                        wcr_by[index[_ikey(owners[-1][1])]].append((cb, ce))
+            for rn, (iface, idxs, datas, state_idxs) in enumerate(resolvers):
+                rcr, wcr = rcr_by[rn], wcr_by[rn]
                 if rcr or wcr or is_state:
                     state_muts = (
                         [m for m in t.mutations if is_metadata_mutation(m)]
@@ -785,7 +876,7 @@ class Proxy:
                     )
 
         reqs, meta = [], []
-        for iface, _b, _e, idxs, datas, state_idxs in resolvers:
+        for iface, idxs, datas, state_idxs in resolvers:
             # every resolver sees every version to keep its chain advancing,
             # even with no transactions for it (Resolver.actor.cpp:104-122)
             reqs.append(
@@ -881,14 +972,8 @@ class Proxy:
 # -- helpers ------------------------------------------------------------------
 
 
-def _clip_ranges(ranges, begin: bytes, end) -> list:
-    out = []
-    for b, e in ranges:
-        cb = max(b, begin)
-        ce = e if end is None else min(e, end)
-        if cb < ce:
-            out.append((cb, ce))
-    return out
+def _ikey(iface):
+    return (iface.address, iface.uid)
 
 
 def make_versionstamp(version: Version, batch_index: int) -> bytes:

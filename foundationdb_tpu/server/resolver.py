@@ -118,6 +118,12 @@ class Resolver:
         self._c_conflicts = self.stats.counter("conflicts")
         self._c_too_old = self.stats.counter("tooOld")
         self.stats.gauge("version", lambda: self.gate.version)
+        # per-range load sample for resolutionBalancing
+        # (Resolver.actor.cpp:276-284 iopsSample): conflict-range begin
+        # keys → op counts, decayed by halving at the cap; cumulative op
+        # count is the master's balance metric (it diffs between polls)
+        self._load_sample: dict[bytes, int] = {}
+        self._load_ops = 0
 
     @property
     def version(self) -> Version:
@@ -155,6 +161,7 @@ class Resolver:
             )
             for t in req.transactions
         ]
+        self._sample_load(req.transactions)
         if buggify():
             await delay(0.001)  # slow resolver (pipeline under jitter)
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
@@ -272,17 +279,76 @@ class Resolver:
             self._exec.stop()
             self._exec = None
 
+    # -- load sampling / repartitioning (resolutionBalancing) ------------------
+
+    def _sample_load(self, transactions) -> None:
+        cap = self.knobs.RESOLUTION_SAMPLE_KEYS
+        sample = self._load_sample
+        for t in transactions:
+            for b, _e in t.read_conflict_ranges:
+                sample[b] = sample.get(b, 0) + 1
+                self._load_ops += 1
+            for b, _e in t.write_conflict_ranges:
+                sample[b] = sample.get(b, 0) + 1
+                self._load_ops += 1
+        if len(sample) > cap:
+            # decay-halve and drop the ones that vanish: recent hot keys
+            # survive, one-off keys age out
+            self._load_sample = {
+                k: v >> 1 for k, v in sample.items() if v >> 1 > 0
+            }
+
+    async def _resolution_metrics(self, _req) -> dict:
+        """Cumulative conflict-range op count (the master's balancer diffs
+        between polls — ResolutionMetricsRequest)."""
+        return {"ops": self._load_ops, "version": self.gate.version}
+
+    async def _split_point(self, req: dict) -> dict:
+        """Find a key carving ~target_ops of sampled load off one end of
+        [begin, end) (ResolutionSplitRequest: front=True carves a prefix,
+        else a suffix). Returns {'key': split_key, 'ops': carved}."""
+        begin, end = req["begin"], req["end"]
+        keys = sorted(
+            k
+            for k in self._load_sample
+            if begin <= k and (end is None or k < end)
+        )
+        if not keys:
+            return {"key": begin, "ops": 0}
+        target = req.get("target_ops", 0)
+        acc = 0
+        if req.get("front", True):
+            for k in keys:
+                if acc >= target and k != begin:
+                    return {"key": k, "ops": acc}
+                acc += self._load_sample[k]
+            return {"key": keys[-1], "ops": acc - self._load_sample[keys[-1]]}
+        for k in reversed(keys):
+            acc += self._load_sample[k]
+            if acc >= target and k != begin:
+                return {"key": k, "ops": acc}
+        # no split inside the segment; the caller rejects key <= begin
+        return {"key": keys[0], "ops": acc}
+
     async def _metrics(self, _req) -> dict:
         return self.stats.snapshot()
 
     def register(self, process) -> None:
         process.register(Tokens.RESOLVE, self.resolve)
         process.register(f"resolver.metrics#{self.uid}", self._metrics)
+        process.register(
+            f"resolver.resolutionMetrics#{self.uid}", self._resolution_metrics
+        )
+        process.register(f"resolver.splitPoint#{self.uid}", self._split_point)
 
     def register_instance(self, process) -> None:
         process.register(f"{Tokens.RESOLVE}#{self.uid}", self.resolve)
         process.register(f"resolver.ping#{self.uid}", self._ping)
         process.register(f"resolver.metrics#{self.uid}", self._metrics)
+        process.register(
+            f"resolver.resolutionMetrics#{self.uid}", self._resolution_metrics
+        )
+        process.register(f"resolver.splitPoint#{self.uid}", self._split_point)
 
     async def _ping(self, _req):
         return "pong"

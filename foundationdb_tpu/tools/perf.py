@@ -95,6 +95,8 @@ def make_workload(args, db, rng, now_fn=None):
             txns_per_actor=args.txns,
             keys_per_txn=args.keys_per_txn,
             now_fn=now_fn,
+            client_id=args.client_id,
+            client_count=max(args.client_procs, 1),
         )
     r, w, _base, _metric = PRESETS[args.workload]
     if args.duration > 0:
@@ -176,11 +178,13 @@ def run_tcp(args) -> dict:
                 "--keyspace", str(args.keyspace),
                 "--keys-per-txn", str(args.keys_per_txn),
                 "--duration", str(args.duration),
+                "--client-procs", str(args.client_procs),
             ]
             for p in range(args.client_procs):
                 procs.append(
                     subprocess.Popen(
-                        child_args + ["--seed", str(args.seed + p)],
+                        child_args
+                        + ["--seed", str(args.seed + p), "--client-id", str(p)],
                         stdout=subprocess.PIPE,
                         text=True,
                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
@@ -232,6 +236,7 @@ def main(argv=None) -> int:
                     help="> 0: time-bounded ThroughputWorkload")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
+    ap.add_argument("--client-id", type=int, default=0, dest="client_id")
     ap.add_argument("--coordinators", default=None)
     ap.add_argument(
         "--tcp-config",

@@ -205,8 +205,13 @@ class BulkLoadWorkload(Workload):
 
         async def writer(w):
             rec = self.rec
+            # globally unique writer index: concurrent client PROCESSES
+            # (tools/perf.py --client-procs) must ingest disjoint ranges,
+            # or the aggregate keys/s double-counts rewrites of the same
+            # keys
+            gw = self.client_id * self.actors + w
             for t in range(self.txns_per_actor):
-                base = (w * self.txns_per_actor + t) * self.keys_per_txn
+                base = (gw * self.txns_per_actor + t) * self.keys_per_txn
 
                 async def body(tr, base=base):
                     for i in range(self.keys_per_txn):
@@ -223,10 +228,12 @@ class BulkLoadWorkload(Workload):
         self.rec.stop_clock()
 
     async def check(self) -> bool:
-        # spot-verify the tail of each writer's range arrived
+        # spot-verify the tail of THIS client's last writer range arrived
         tr = self.db.transaction()
         last = (
-            (self.actors * self.txns_per_actor) * self.keys_per_txn - 1
+            ((self.client_id + 1) * self.actors * self.txns_per_actor)
+            * self.keys_per_txn
+            - 1
         )
         return (await tr.get(self.prefix + b"%012d" % last)) is not None
 
@@ -255,7 +262,7 @@ class ThroughputWorkload(ReadWriteWorkload):
                 if not started[0] and rec.now() >= ramp_until:
                     started[0] = True
                     # reset counters at steady state; wall clock restarts
-                    rec.reads = rec.writes = rec.commits = 0
+                    rec.reads = rec.writes = rec.commits = rec.conflicts = 0
                     rec.read_lat.clear()
                     rec.commit_lat.clear()
                     rec.t0_wall = time.perf_counter()
