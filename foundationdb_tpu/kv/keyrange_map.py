@@ -66,16 +66,20 @@ class KeyRangeMap:
     def intersecting(
         self, begin: bytes, end: Optional[bytes]
     ) -> list[Tuple[bytes, Optional[bytes], Any]]:
-        """Ranges overlapping [begin, end), clipped to it."""
+        """Ranges overlapping [begin, end), clipped to it. O(log n + k):
+        this sits on the proxy's per-conflict-range routing hot path."""
         out = []
-        for b, e, v in self.ranges():
+        i = self._idx(begin)
+        n = len(self._bounds)
+        while i < n:
+            b = self._bounds[i]
             if end is not None and b >= end:
                 break
-            if e is not None and e <= begin:
-                continue
+            e = self._bounds[i + 1] if i + 1 < n else None
             cb = max(b, begin)
             ce = e if end is None else (end if e is None else min(e, end))
-            out.append((cb, ce, v))
+            out.append((cb, ce, self._vals[i]))
+            i += 1
         return out
 
     def _split_at(self, key: bytes) -> None:

@@ -11,7 +11,13 @@ from __future__ import annotations
 
 import struct
 
-PROTOCOL_VERSION = 0x0FDB00B070010001  # fdb-tpu, format generation 1
+# fdb-tpu wire format generation. The codec decodes structs positionally
+# (schema-by-convention), so ANY dataclass field change in a wire type
+# MUST bump this — mixed-build processes then reject each other at the
+# handshake instead of raising mid-stream.
+# gen 2: GetCommitVersionRequest.applied_changes_version +
+#        GetCommitVersionReply.resolver_changes[,_version]
+PROTOCOL_VERSION = 0x0FDB00B070010002
 
 
 class BinaryWriter:

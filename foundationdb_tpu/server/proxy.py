@@ -823,9 +823,6 @@ class Proxy:
         # [(iface, idxs, datas, state_idxs)] in fixed epoch order
         resolvers = [(iface, [], [], []) for iface in universe]
 
-        moving = any(
-            len(owners) > 1 for _b, _e, owners in self.key_resolvers.ranges()
-        )
         single = len(universe) == 1
         for i, t in enumerate(txns):
             is_state = any(is_metadata_mutation(m) for m in t.mutations)
@@ -839,9 +836,6 @@ class Proxy:
                     for cb, ce, owners in self.key_resolvers.intersecting(
                         rb, re_
                     ):
-                        if not moving:
-                            rcr_by[index[_ikey(owners[-1][1])]].append((cb, ce))
-                            continue
                         for j in range(len(owners) - 1, -1, -1):
                             v, iface = owners[j]
                             rcr_by[index[_ikey(iface)]].append((cb, ce))

@@ -85,12 +85,14 @@ class ResolutionBalancer:
         ):
             return False
 
-        # find a segment owned by the busiest that ADJOINS a segment of
-        # the laziest (shift the shared boundary); else move half of the
-        # busiest's first segment outright (the map tolerates
-        # non-contiguous ownership)
+        # candidate segments of the busiest, preferring ones that ADJOIN a
+        # segment of the laziest (shift the shared boundary); non-adjacent
+        # segments follow as fallbacks (the map tolerates non-contiguous
+        # ownership). Try candidates IN ORDER until one yields a usable
+        # split — a cold adjacent segment with no sampled load must not
+        # livelock the balancer while a hot non-adjacent one exists.
         segs = self._segments()
-        pick = None  # (seg_index, front: carve prefix?)
+        adjacent, fallback = [], []
         for i, (b, e, iface) in enumerate(segs):
             if (iface.address, iface.uid) != busiest:
                 continue
@@ -98,36 +100,33 @@ class ResolutionBalancer:
                 segs[i - 1][2].address,
                 segs[i - 1][2].uid,
             ) == laziest:
-                pick = (i, True)  # prefix joins the predecessor
-                break
-            if i + 1 < len(segs) and (
+                adjacent.append((i, True))  # prefix joins the predecessor
+            elif i + 1 < len(segs) and (
                 segs[i + 1][2].address,
                 segs[i + 1][2].uid,
             ) == laziest:
-                pick = (i, False)  # suffix joins the successor
-                break
-        if pick is None:
-            for i, (b, e, iface) in enumerate(segs):
-                if (iface.address, iface.uid) == busiest:
-                    pick = (i, False)
-                    break
-        if pick is None:
-            return False
-        i, front = pick
-        begin, end, src = segs[i]
+                adjacent.append((i, False))  # suffix joins the successor
+            else:
+                fallback.append((i, False))
 
-        split = await process.request(
-            Endpoint(src.address, f"resolver.splitPoint#{src.uid}"),
-            {
-                "begin": begin,
-                "end": end,
-                "front": front,
-                "target_ops": diff // 2,
-            },
-        )
-        key = split["key"]
-        if key <= begin or (end is not None and key >= end):
-            return False  # no usable split inside the segment
+        begin = end = src = key = front = None
+        for i, fr in adjacent + fallback:
+            b, e, s = segs[i]
+            split = await process.request(
+                Endpoint(s.address, f"resolver.splitPoint#{s.uid}"),
+                {
+                    "begin": b,
+                    "end": e,
+                    "front": fr,
+                    "target_ops": diff // 2,
+                },
+            )
+            k = split["key"]
+            if k > b and (e is None or k < e):
+                begin, end, src, key, front = b, e, s, k, fr
+                break
+        if key is None:
+            return False  # no segment has a usable split
 
         dst = ifaces[laziest]
         if front:
@@ -155,11 +154,25 @@ class ResolutionBalancer:
 
     async def run(self, process) -> None:
         """The master-side actor: poll/balance forever."""
+        from ..runtime.trace import SevWarn, trace
+
+        failures = 0
         while True:
             await delay(self.knobs.RESOLUTION_BALANCING_INTERVAL)
             try:
                 await self.step(process)
-            except Exception:
-                # a resolver mid-restart is survivable; recovery replaces
-                # this balancer with the epoch anyway
-                pass
+                failures = 0
+            except Exception as e:
+                # a resolver mid-restart is survivable (recovery replaces
+                # this balancer with the epoch), but PERSISTENT failure
+                # means balancing is silently dead — give the operator a
+                # signal, with backoff so it isn't per-interval spam
+                failures += 1
+                if failures in (3, 30, 300):
+                    trace(
+                        SevWarn,
+                        "ResolutionBalancerFailing",
+                        getattr(process, "address", ""),
+                        Failures=failures,
+                        Err=repr(e)[:200],
+                    )

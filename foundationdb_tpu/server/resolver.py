@@ -293,10 +293,14 @@ class Resolver:
                 self._load_ops += 1
         if len(sample) > cap:
             # decay-halve and drop the ones that vanish: recent hot keys
-            # survive, one-off keys age out
-            self._load_sample = {
-                k: v >> 1 for k, v in sample.items() if v >> 1 > 0
-            }
+            # survive, one-off keys age out. Halving alone doesn't bound
+            # the dict when > cap distinct keys stay warm — keep the top
+            # `cap` by count so the rebuild can't run on every batch
+            decayed = {k: v >> 1 for k, v in sample.items() if v >> 1 > 0}
+            if len(decayed) > cap:
+                keep = sorted(decayed, key=decayed.get, reverse=True)[:cap]
+                decayed = {k: decayed[k] for k in keep}
+            self._load_sample = decayed
 
     async def _resolution_metrics(self, _req) -> dict:
         """Cumulative conflict-range op count (the master's balancer diffs

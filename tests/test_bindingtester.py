@@ -1,0 +1,153 @@
+"""Bindingtester-style API conformance (bindingtester.py analog).
+
+Seeded stack-machine instruction streams run against BOTH the real
+client (on a simulated cluster, instructions stored in the database per
+the spec) and the serial-MVCC model oracle; the logged stacks and final
+data states must match item for item. A chaos tier re-runs streams under
+buggify + clogging and checks the machine survives with a consistent
+final state.
+"""
+
+import pytest
+
+from foundationdb_tpu.bindings import ModelDatabase, StackMachine
+from foundationdb_tpu.bindings.generator import StreamGenerator, store_instructions
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.layers import tuple as T
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn
+from foundationdb_tpu.server import Cluster, ClusterConfig
+
+INS_PREFIX = b"bt/i"
+DATA_PREFIX = b"bt/d/"
+RESULT_PREFIX = b"bt/r/"
+
+
+def run_real(seed, n_ops, chaos=False, **cfg):
+    sim = Sim(seed=seed, chaos=chaos)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(**cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    gen = StreamGenerator(seed, data_prefix=DATA_PREFIX)
+    stream = gen.generate(n_ops, result_prefix=RESULT_PREFIX)
+
+    async def go():
+        await store_instructions(db, INS_PREFIX, stream)
+        machine = StackMachine(db, INS_PREFIX)
+        await machine.run_from_db()
+
+        async def read_all(tr):
+            data = await tr.get_range(DATA_PREFIX, DATA_PREFIX + b"\xff")
+            log = await tr.get_range(RESULT_PREFIX, RESULT_PREFIX + b"\xff")
+            return data, log
+
+        return await db.run(read_all)
+
+    return stream, sim.run_until_done(spawn(go()), 3600.0)
+
+
+def run_model(stream):
+    """The oracle side: same machine, model database."""
+    from foundationdb_tpu.net.sim import Sim
+
+    sim = Sim(seed=0)  # an event loop for the async surface
+    sim.activate()
+    db = ModelDatabase()
+
+    async def go():
+        machine = StackMachine(db, INS_PREFIX)
+        await machine.run_stream(stream)
+        data = sorted(
+            (k, v) for k, v in db.data.items() if k.startswith(DATA_PREFIX)
+        )
+        log = sorted(
+            (k, v) for k, v in db.data.items() if k.startswith(RESULT_PREFIX)
+        )
+        return data, log
+
+    return sim.run_until_done(spawn(go()), 3600.0)
+
+
+@pytest.mark.parametrize("seed", range(50))
+def test_conformance_seeded_streams(seed):
+    stream, (data_real, log_real) = run_real(seed, 1000)
+    data_model, log_model = run_model(stream)
+    assert list(data_real) == list(data_model), (
+        f"seed {seed}: final data diverged "
+        f"(real {len(data_real)} rows, model {len(data_model)})"
+    )
+    assert list(log_real) == list(log_model), (
+        f"seed {seed}: logged stacks diverged "
+        f"(real {len(log_real)} items, model {len(log_model)})"
+    )
+
+
+def test_conformance_long_stream():
+    """One 1K-op stream, multi-proxy multi-resolver cluster."""
+    stream, (data_real, log_real) = run_real(
+        99, 1000, n_proxies=2, n_resolvers=2
+    )
+    data_model, log_model = run_model(stream)
+    assert list(data_real) == list(data_model)
+    assert list(log_real) == list(log_model)
+
+
+def test_error_tuples_surface_conflicts():
+    """A forced conflict between two named transactions must surface as
+    the packed ('ERROR', '1020') tuple on BOTH sides at the same stream
+    position."""
+    stream = [
+        ("NEW_TRANSACTION",),
+        # tr A (default name) reads k
+        ("PUSH", DATA_PREFIX + b"k"),
+        ("GET",),
+        ("POP",),
+        # tr B writes k and commits
+        ("PUSH", b"trB"),
+        ("USE_TRANSACTION",),
+        ("PUSH", b"vB"),
+        ("PUSH", DATA_PREFIX + b"k"),
+        ("SET",),
+        ("COMMIT",),
+        ("POP",),
+        # back to A: write + commit must conflict
+        ("PUSH", INS_PREFIX),
+        ("USE_TRANSACTION",),
+        ("PUSH", b"vA"),
+        ("PUSH", DATA_PREFIX + b"k"),
+        ("SET",),
+        ("COMMIT",),
+        ("PUSH", RESULT_PREFIX),
+        ("LOG_STACK",),
+    ]
+
+    sim = Sim(seed=7)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig())
+    db = Database(sim, cluster.proxy_addrs)
+
+    async def go():
+        machine = StackMachine(db, INS_PREFIX)
+        await machine.run_stream(stream)
+
+        async def read_log(tr):
+            return await tr.get_range(RESULT_PREFIX, RESULT_PREFIX + b"\xff")
+
+        return await db.run(read_log)
+
+    log_real = sim.run_until_done(spawn(go()), 600.0)
+    data_model, log_model = run_model(stream)
+    assert [v for _k, v in log_real] == [v for _k, v in log_model]
+    # the last logged item is the conflict error tuple
+    assert T.unpack(T.unpack(log_real[-1][1])[0]) == (b"ERROR", b"1020")
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29, 41])
+def test_streams_survive_chaos(seed):
+    """Under buggify, the machine must complete and the final state must
+    be readable and well-formed (per-instruction parity is not required —
+    chaos errors are environmental, as in the reference's chaos runs)."""
+    stream, (data_real, log_real) = run_real(seed, 250, chaos=True)
+    for k, v in data_real:
+        assert k.startswith(DATA_PREFIX)
+        assert isinstance(v, bytes)

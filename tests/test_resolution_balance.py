@@ -28,10 +28,6 @@ def drive(sim, coro, limit=600.0):
     return sim.run_until_done(spawn(coro), limit)
 
 
-def resolver_txn_counts(cluster):
-    return [int(r._c_txns.value) for r in cluster.resolvers]
-
-
 def force_move(cluster, begin, end, dst_iface):
     ok = cluster.master.set_resolver_changes(
         [(begin, end, dst_iface)], [p.uid for p in cluster.proxies]
@@ -48,36 +44,16 @@ def newest_owner_map(proxy):
 
 def test_hot_prefix_moves_boundary_and_rebalances():
     """All load on a hot prefix deep inside one resolver's range: the
-    balancer must move a boundary, and post-move traffic must spread."""
+    balancer must move a boundary, and post-move traffic must spread.
+    (Scenario shared with dryrun_multichip via rebalance_drill.)"""
+    from foundationdb_tpu.workloads.rebalance_drill import hot_prefix_rebalance
+
     sim, cluster, db = make_db(seed=31, n_resolvers=2, n_proxies=2)
     balancer = cluster.start_resolution_balancer()
 
     async def go():
-        async def burst(n):
-            for i in range(n):
-                tr = db.transaction()
-                # reads + writes confined to a hot prefix in resolver 1's
-                # half of the keyspace (static split is at 0x80)
-                k = b"\xc0hot/%04d" % (i % 50)
-                await tr.get(k)
-                tr.set(k, b"v%d" % i)
-                try:
-                    await tr.commit()
-                except NotCommitted:
-                    pass
-
-        await burst(150)
-        # let the balancer poll, split, and record the move
-        for _ in range(12):
-            await delay(0.5)
-            if balancer.moves:
-                break
-        assert balancer.moves >= 1, "no boundary move despite hot prefix"
-
-        before = resolver_txn_counts(cluster)
-        await burst(150)
-        after = resolver_txn_counts(cluster)
-        gained = [a - b for b, a in zip(before, after)]
+        moves, gained = await hot_prefix_rebalance(cluster, db, balancer)
+        assert moves >= 1, "no boundary move despite hot prefix"
         # both resolvers saw a real share of post-move traffic (pre-move,
         # resolver 0 saw only empty/system batches)
         assert min(gained) > 0, gained
