@@ -177,14 +177,22 @@ class StreamGenerator:
         (gen_stack_noise, 6),
     ]
 
-    def generate(self, n_ops: int, result_prefix=b"bt/r/") -> list[tuple]:
+    def generate(
+        self,
+        n_ops: int,
+        result_prefix=b"bt/r/",
+        machine_prefix=b"bt/i",
+    ) -> list[tuple]:
+        """``machine_prefix`` must match the StackMachine's prefix: it is
+        the DEFAULT transaction's name, and the tail settle must commit
+        it or trailing writes on it are silently dropped."""
         fns = [f for f, _w in self.GENERATORS]
         weights = [w for _f, w in self.GENERATORS]
         self.emit("NEW_TRANSACTION")
         while len(self.ins) < n_ops:
             self.rnd.choices(fns, weights)[0](self)
         # settle every named transaction, then log the stack
-        for name in (b"tr0", b"tr1", b"tr2", self.data_prefix):
+        for name in (b"tr0", b"tr1", b"tr2", machine_prefix):
             self.emit("PUSH", name)
             self.emit("USE_TRANSACTION")
             self.emit("COMMIT")

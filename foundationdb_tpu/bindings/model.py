@@ -15,7 +15,7 @@ Reference provenance: semantics from fdbclient/ReadYourWrites.actor.cpp
 
 from __future__ import annotations
 
-from ..errors import NotCommitted, TransactionTooOld
+from ..errors import FdbError, NotCommitted, TransactionTooOld
 from ..kv.atomic import apply_atomic
 from ..kv.mutations import MutationType
 
@@ -217,7 +217,9 @@ class ModelTransaction:
         self.__init__(self.db)
 
     async def on_error(self, e: Exception) -> None:
-        if isinstance(e, (NotCommitted, TransactionTooOld)):
+        # mirror the real client's predicate (transaction.py on_error):
+        # any retryable FdbError resets; everything else re-raises
+        if isinstance(e, FdbError) and e.retryable:
             self.reset()
             return
         raise e

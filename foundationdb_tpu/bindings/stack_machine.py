@@ -24,6 +24,8 @@ from __future__ import annotations
 from ..errors import FdbError
 from ..kv.mutations import MutationType
 from ..layers import tuple as T
+from ..net.sim import BrokenPromise
+from ..client.transaction import strinc as _strinc
 
 ERROR_CODES = {
     "NotCommitted": b"1020",
@@ -108,7 +110,11 @@ class StackMachine:
             raise NotImplementedError(f"instruction {op!r}")
         try:
             await handler(inum, ins, snapshot=snapshot, database=database)
-        except FdbError as e:
+        except (FdbError, BrokenPromise) as e:
+            # the spec: ANY error bubbling out of an operation is caught
+            # and pushed as the packed error tuple — including transport
+            # breakage under chaos (BrokenPromise), which maps to the
+            # generic code
             self.push(inum, _error_tuple(e))
 
     # -- data ops --------------------------------------------------------------
@@ -352,10 +358,3 @@ class StackMachine:
         packed = self.pop(n)
         for p in sorted(packed):
             self.push(inum, p)
-
-
-def _strinc(prefix: bytes) -> bytes:
-    p = prefix.rstrip(b"\xff")
-    if not p:
-        return b"\xff\xff"
-    return p[:-1] + bytes([p[-1] + 1])

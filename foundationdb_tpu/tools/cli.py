@@ -128,16 +128,27 @@ class FdbCli:
 
     # -- backup (the fdbbackup personalities, fdbbackup/backup.actor.cpp) ------
 
+    def _container_for(self, name: str):
+        """Container URL dispatch (BackupContainer.actor.cpp:1): a
+        blobstore://host:port/bucket/name target over real HTTP, or the
+        default disk-backed container."""
+        if name.startswith("blobstore://"):
+            from ..backup.blobstore import open_container
+            from ..runtime.loop import current_loop
+
+            return open_container(name, loop=current_loop())
+        from ..backup import BackupContainer
+
+        return BackupContainer(self.db.sim.disk("backup-store"), name)
+
     async def _cmd_backup(self, args) -> str:
-        """backup start <container> | backup discontinue"""
-        from ..backup import BackupAgent, BackupContainer
+        """backup start <container-or-url> | backup discontinue"""
+        from ..backup import BackupAgent
 
         sub = args[0]
         if sub == "start":
             name = args[1] if len(args) > 1 else "backup"
-            container = BackupContainer(
-                self.db.sim.disk("backup-store"), name
-            )
+            container = self._container_for(name)
             agent = BackupAgent(self.db, container, uid=name)
             await agent.submit()
             await agent.wait_snapshot_complete()
@@ -154,12 +165,10 @@ class FdbCli:
         return "ERROR: backup start|discontinue"
 
     async def _cmd_restore(self, args) -> str:
-        from ..backup import BackupContainer
         from ..backup.agent import restore
 
         name = args[0] if args else "backup"
-        container = BackupContainer(self.db.sim.disk("backup-store"), name)
-        n = await restore(self.db, container)
+        n = await restore(self.db, self._container_for(name))
         return f"Restored {n} snapshot rows (+ mutation log)"
 
     async def _cmd_configure(self, args) -> str:

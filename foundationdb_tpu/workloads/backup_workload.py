@@ -15,17 +15,42 @@ from ..runtime.futures import delay
 
 
 class BackupWorkload(Workload):
-    def __init__(self, db, rng, sim=None, writes=30, prefix=b"bk/", **kw):
+    def __init__(
+        self,
+        db,
+        rng,
+        sim=None,
+        writes=30,
+        prefix=b"bk/",
+        container_url=None,  # e.g. "blobstore://blobhost:80/bk/soak"
+        **kw,
+    ):
         super().__init__(db, rng, **kw)
         self.sim = sim
         self.writes = writes
         self.prefix = prefix
+        self.container_url = container_url
         self.ok = False
 
-    async def start(self):
-        container = BackupContainer(
+    def _make_container(self):
+        """Parameterized over the container scheme
+        (fdbclient/BackupContainer.actor.cpp:1 URL dispatch): the default
+        file-style disk container, or a blobstore:// target whose HTTP
+        bytes ride the sim network."""
+        if self.container_url:
+            from ..backup.blobstore import open_container
+
+            return open_container(
+                self.container_url,
+                sim=self.sim,
+                process=self.db.client,
+            )
+        return BackupContainer(
             self.sim.disk("backup-workload-store"), "soak"
         )
+
+    async def start(self):
+        container = self._make_container()
         # capture ONLY our prefix: a whole-keyspace restore would roll
         # back concurrent workloads' later writes
         agent = BackupAgent(

@@ -29,7 +29,7 @@ def run_real(seed, n_ops, chaos=False, **cfg):
     cluster = Cluster(sim, ClusterConfig(**cfg))
     db = Database(sim, cluster.proxy_addrs)
     gen = StreamGenerator(seed, data_prefix=DATA_PREFIX)
-    stream = gen.generate(n_ops, result_prefix=RESULT_PREFIX)
+    stream = gen.generate(n_ops, result_prefix=RESULT_PREFIX, machine_prefix=INS_PREFIX)
 
     async def go():
         await store_instructions(db, INS_PREFIX, stream)
