@@ -92,40 +92,81 @@ async def configure(db, coordinators: list[str], client, **changes) -> None:
     await force_recovery(coordinators, client)
 
 
-async def force_recovery(coordinators: list[str], client) -> None:
-    """Ask the cluster controller to replace the master (a recovery)."""
+async def _leader_request(
+    coordinators: list[str],
+    client,
+    token: str,
+    payload,
+    per_try_timeout: float = 10.0,
+    attempts: int = 1,
+    accept=lambda r: True,
+):
+    """Find the current cluster controller and send it one request,
+    re-discovering and retrying up to ``attempts`` times (the CC may be
+    mid-(re)election). Raises TimeoutError when no CC ever accepts."""
     from ..server.coordination import monitor_leader
     from ..runtime.futures import AsyncVar, timeout as _timeout
 
     leader = AsyncVar(None)
     mon = client.spawn(monitor_leader(client, coordinators, leader))
     try:
-        while leader.get() is None:
-            await leader.on_change()
-        cc = leader.get()
-        await _timeout(
-            client.request(Endpoint(cc.address, Tokens.CC_FORCE_RECOVERY), None),
-            5.0,
-        )
+        for _ in range(attempts):
+            if leader.get() is None:
+                # bounded: no leader may EVER appear (lost coordinator
+                # majority) — the attempt budget must still apply
+                await _timeout(leader.on_change(), 1.0)
+                if leader.get() is None:
+                    continue
+            cc = leader.get()
+            try:
+                reply = await _timeout(
+                    client.request(Endpoint(cc.address, token), payload),
+                    per_try_timeout,
+                )
+                if accept(reply):
+                    return reply
+            except Exception:
+                pass
+            await delay(0.5)
+        raise TimeoutError(f"no cluster controller answered {token}")
     finally:
         mon.cancel()
+
+
+async def force_failover(coordinators: list[str], client, dc: str) -> None:
+    """Promote region ``dc`` to primary after losing the current primary
+    (fdbcli force_recovery_with_data_loss): the next recovery determines
+    the epoch end from the surviving LogRouters and promotes the storage
+    mirror. Commits acked but never relayed to the remote are lost — the
+    operation's contract (as are metadata changes committed after the
+    last recovery; configure() forces a recovery immediately, so that
+    window is the balancer/DD traffic since the current epoch began)."""
+    await _leader_request(
+        coordinators,
+        client,
+        Tokens.CC_FORCE_FAILOVER,
+        dc,
+        attempts=60,
+        accept=bool,
+    )
+
+
+async def force_recovery(coordinators: list[str], client) -> None:
+    """Ask the cluster controller to replace the master (a recovery)."""
+    await _leader_request(
+        coordinators,
+        client,
+        Tokens.CC_FORCE_RECOVERY,
+        None,
+        per_try_timeout=5.0,
+        attempts=10,
+    )
 
 
 async def get_status(coordinators: list[str], client) -> dict:
     """Fetch the cluster status JSON document from the CC
     (StatusClient / fdbcli `status json`)."""
-    from ..server.coordination import monitor_leader
-    from ..runtime.futures import AsyncVar, timeout as _timeout
-
-    leader = AsyncVar(None)
-    mon = client.spawn(monitor_leader(client, coordinators, leader))
-    try:
-        while leader.get() is None:
-            await leader.on_change()
-        cc = leader.get()
-        status = await _timeout(
-            client.request(Endpoint(cc.address, Tokens.CC_GET_STATUS), None), 10.0
-        )
-        return status or {}
-    finally:
-        mon.cancel()
+    status = await _leader_request(
+        coordinators, client, Tokens.CC_GET_STATUS, None, attempts=10
+    )
+    return status or {}

@@ -25,6 +25,15 @@ class DurabilityOracle:
         if version > self.max_acked:
             self.max_acked = version
 
+    def forfeit_above(self, version: int) -> None:
+        """A forced lossy operation (force_recovery_with_data_loss /
+        region failover) explicitly gives up acked commits above
+        ``version`` — lower the watermark so later recoveries aren't
+        charged with the forfeited tail. The loss is the operation's
+        documented contract, not a durability bug."""
+        if self.max_acked > version:
+            self.max_acked = version
+
     def check_recovery(self, end_version: int, epoch: int) -> None:
         """A new epoch's end version must cover every acked commit."""
         if end_version < self.max_acked:

@@ -231,12 +231,15 @@ class DynamicCluster:
             )
 
         # remote region: storage mirror workers + router hosts in a
-        # second dc (never eligible for CC/master — the primary region
-        # runs the transaction subsystem)
+        # second dc. In NORMAL operation the primary region runs the
+        # transaction subsystem (master_core restricts primary roles to
+        # it), but remote workers stay CC-eligible: after a region
+        # failover they are the only processes left to elect one
+        # (the reference's CC can run in any region).
         if cfg.remote_dc:
             r_classes = ["storage"] * cfg.n_storage + ["transaction"] * max(
                 cfg.n_log_routers, 1
-            )
+            ) + ["stateless"]
             for i, pclass in enumerate(r_classes):
                 addr = f"{prefix}remote{i}"
                 self.worker_addrs.append(addr)
@@ -247,7 +250,6 @@ class DynamicCluster:
                         pclass,
                         cfg.as_dict(),
                         self.knobs,
-                        can_be_cc=False,
                     ),
                     zone=f"{prefix}{cfg.remote_dc}-z{i}",
                     dc=cfg.remote_dc,

@@ -44,6 +44,11 @@ class ClusterController:
         self._actors = []
         self._master_n = 0
         self._master_at: tuple = None  # (worker address, uid) of current master
+        # forced region failover (force_recovery_with_data_loss analog):
+        # sticky until a recovery under the override publishes its dbinfo,
+        # so a master dying MID-failover-recovery doesn't lose the intent
+        self._failover_to: str = None
+        self._failover_master_uid: str = None  # recruited with the override
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -56,6 +61,7 @@ class ClusterController:
         p.register(Tokens.CC_GET_DB_INFO, self.get_db_info)
         p.register(Tokens.CC_GET_STATUS, self.get_status)
         p.register(Tokens.CC_FORCE_RECOVERY, self.force_recovery)
+        p.register(Tokens.CC_FORCE_FAILOVER, self.force_failover)
         self._actors.append(p.spawn(self.cluster_watch_database()))
         self._actors.append(p.spawn(self._broadcast_loop()))
 
@@ -68,6 +74,7 @@ class ClusterController:
             Tokens.CC_GET_DB_INFO,
             Tokens.CC_GET_STATUS,
             Tokens.CC_FORCE_RECOVERY,
+            Tokens.CC_FORCE_FAILOVER,
         ):
             self.process.endpoints.pop(t, None)
         for a in self._actors:
@@ -107,8 +114,25 @@ class ClusterController:
             if not workers:
                 await delay(self.knobs.HEARTBEAT_INTERVAL)
                 continue
-            # prefer a stateless-class worker not already running roles
-            workers.sort(key=lambda w: (w.process_class != "stateless", len(w.roles)))
+            # prefer: the primary region (the configured remote dc hosts
+            # the master only when a failover targets it or nothing else
+            # is left), then a stateless-class worker not already running
+            # roles
+            rdc = str(self.initial_config.get("remote_dc", "") or "")
+            pref_dc = self._failover_to
+
+            def in_secondary(w):
+                if pref_dc:
+                    return getattr(w, "dc", "") != pref_dc
+                return bool(rdc) and getattr(w, "dc", "") == rdc
+
+            workers.sort(
+                key=lambda w: (
+                    in_secondary(w),
+                    w.process_class != "stateless",
+                    len(w.roles),
+                )
+            )
             target = workers[0]
             self._master_n += 1
             uid = f"master-{self._master_n}-{self.process.sim.loop.random.random_int(0, 1 << 20)}"
@@ -122,7 +146,14 @@ class ClusterController:
                             params=dict(
                                 coordinators=self.coordinators,
                                 cc_address=self.process.address,
-                                initial_config=self.initial_config,
+                                initial_config=dict(
+                                    self.initial_config,
+                                    **(
+                                        {"failover_to": self._failover_to}
+                                        if self._failover_to
+                                        else {}
+                                    ),
+                                ),
                             ),
                         ),
                     ),
@@ -139,6 +170,8 @@ class ClusterController:
                 Uid=uid,
             )
             self._master_at = (target.address, uid)
+            if self._failover_to:
+                self._failover_master_uid = uid
             # watch it: the master's ping endpoint vanishes when it dies
             ping = Endpoint(target.address, f"master.ping#{uid}")
             misses = 0
@@ -160,6 +193,14 @@ class ClusterController:
         cur = self.db_info.get()
         if cur is None or req.info.id > cur.id:
             self.db_info.set(req.info)
+        if (
+            self._failover_to is not None
+            and req.info.master_uid == self._failover_master_uid
+        ):
+            # a recovery recruited WITH the override completed: done.
+            # (An unrelated recovery finishing must NOT clear the intent.)
+            self._failover_to = None
+            self._failover_master_uid = None
         return None
 
     async def get_db_info(self, _req) -> ServerDBInfo:
@@ -202,6 +243,21 @@ class ClusterController:
             await wait_for_any(await_any)
 
     # -- operator actions --------------------------------------------------------
+
+    async def force_failover(self, dc):
+        """Forced region failover (fdbcli force_recovery_with_data_loss,
+        fdbclient/ManagementAPI forceRecovery): promote the region ``dc``
+        to primary. The next master recovery skips locking the (dead)
+        primary tlog generation, determines the epoch end from the
+        surviving LogRouters' relayed frontiers, and promotes the storage
+        mirror — anything acked but never relayed is LOST, which is the
+        operation's documented contract."""
+        self._failover_to = str(dc)
+        trace(
+            SevInfo, "ForcedFailover", self.process.address, To=str(dc)
+        )
+        await self.force_recovery(None)
+        return True
 
     async def force_recovery(self, _req):
         """Kill the current master role; the watch loop recruits a fresh

@@ -434,4 +434,5 @@ class Tokens:
     CC_GET_DB_INFO = "cc.getServerDBInfo"
     CC_GET_STATUS = "cc.getStatus"
     CC_FORCE_RECOVERY = "cc.forceRecovery"
+    CC_FORCE_FAILOVER = "cc.forceFailover"
     WORKER_DESTROY_ROLE = "worker.destroyRole"

@@ -221,6 +221,32 @@ class MasterTerminated(Exception):
     """This master's tenure is over (fenced, or a role it recruited died)."""
 
 
+async def _router_frontier(process, router_set: TLogSet) -> int:
+    """Failover epoch end: min over every surviving router's relayed
+    frontier — every tag has relayed through at least that version, so
+    the promoted mirror's history (routers + applied state) is complete
+    below it. Retries until every router answers (they live in the
+    surviving region; one mid-restart must not lose its tags' tail)."""
+    if router_set is None:
+        raise MasterTerminated("failover without a router generation")
+    for _ in range(40):
+        try:
+            versions = []
+            for log in router_set.logs:
+                v = await process.request(
+                    Endpoint(log.address, f"router.version#{log.log_id}"),
+                    None,
+                )
+                versions.append(int(v))
+            return min(versions)
+        except Exception:
+            await delay(0.5)
+    # a surviving-region router is permanently gone: die so the CC
+    # recruits a successor (the failover override is sticky there) —
+    # wedging here would leave a master that pings healthy forever
+    raise MasterTerminated("failover: router frontier unreachable")
+
+
 async def master_core(process, uid: str, coordinators, cc_address, initial_config):
     """The whole master lifetime: recovery, then service until failure.
     Raises MasterTerminated/ClusterStateChanged when a successor must be
@@ -245,18 +271,61 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     config = dict(initial_config or {})
     if prev:
         config = dict(prev.config)
+    # forced region failover (force_recovery_with_data_loss): the CC
+    # passes the surviving dc; this recovery promotes it to primary
+    failover_to = str((initial_config or {}).get("failover_to", "") or "")
+    if failover_to and prev and prev.remote_storage:
+        config["remote_dc"] = ""
+        config["primary_dc"] = failover_to
+        # sticky: every later epoch publishes log_routers mirroring the
+        # primary log system, because the promoted (remote-wired) storage
+        # follows router_config forever
+        config["failover_promoted"] = "1"
+    else:
+        failover_to = ""
     trace(
         SevInfo,
         "MasterRecoveryState",
         process.address,
         State="reading_cstate_done",
         RecoveryCount=recovery_count,
+        Failover=failover_to,
     )
 
     # LOCKING_CSTATE: fence the prior generation, find the recovery version
     old_sets: list[OldTLogSet] = []
     recovery_version = 0
-    if prev:
+    locks: dict = {}
+    if prev and failover_to:
+        # the primary region (its tlogs included) is presumed dead: the
+        # epoch end comes from the surviving LogRouters' relayed
+        # frontiers instead of tlog locks. Anything acked at the primary
+        # but never relayed is LOST — the operation's documented
+        # contract; the failover drill converges the mirror first so the
+        # sim durability oracle still passes.
+        recovery_version = await _router_frontier(process, prev.router_set)
+        oracle = getattr(getattr(process, "sim", None), "validation", None)
+        if oracle is not None:
+            # data-loss failover: acked commits the routers never relayed
+            # are FORFEITED by contract — record that instead of asserting
+            # (the drill proves the converged case separately)
+            oracle.forfeit_above(recovery_version)
+            oracle.check_recovery(recovery_version, recovery_count)
+        # the promoted epoch's history lives in the routers: they serve
+        # tlog-shaped peeks for everything the mirror hasn't applied yet
+        old_sets = [o for o in prev.old_router_sets]
+        if prev.router_set is not None:
+            old_sets.append(
+                OldTLogSet(set=prev.router_set, end_version=recovery_version)
+            )
+        trace(
+            SevInfo,
+            "MasterRecoveryState",
+            process.address,
+            State="failover_frontier",
+            RecoveryVersion=recovery_version,
+        )
+    elif prev:
         locks = await lock_tlog_set(process, prev.tlog_set, recovery_count)
         recovery_version = epoch_end_version(locks)
         known = max(r.known_committed for r in locks.values())
@@ -295,11 +364,16 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     # primary roles never land in the remote dc (the remote region hosts
     # only routers + the storage mirror)
     _rdc = str(config.get("remote_dc", "") or "")
-    primary_workers = (
-        [w for w in workers if getattr(w, "dc", "") != _rdc]
-        if _rdc
-        else workers
-    )
+    _pdc = str(config.get("primary_dc", "") or "")
+    if _pdc:
+        # post-failover: transaction roles live in the promoted region
+        primary_workers = [
+            w for w in workers if getattr(w, "dc", "") == _pdc
+        ] or workers
+    elif _rdc:
+        primary_workers = [w for w in workers if getattr(w, "dc", "") != _rdc]
+    else:
+        primary_workers = workers
     picker = _RolePicker(primary_workers, avoid={process.address})
 
     # storage: seeded once on a brand-new database, then immortal.
@@ -343,6 +417,45 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                             config[name] = m.param2.decode()
             break  # txs rides every tlog; any locked one is complete
         shards = shard_map.to_list()
+        if failover_to:
+            # promote the mirror: the remote storage interfaces become
+            # THE storage. The shard map is rebuilt from the MIRRORS' OWN
+            # applied ownership, not the coordinated snapshot — shard
+            # moves committed since the last recovery relayed to the
+            # mirrors with the data, and the stale snapshot would point
+            # moved ranges at the wrong tag.
+            by_tag = {s.tag: s for s in prev.remote_storage}
+            storage = [by_tag[t] for t in sorted(by_tag)]
+            promoted_shards = []
+            from ..kv.keyrange_map import KeyRangeMap as _KRM
+
+            cover = _KRM(default=None)
+            for t in sorted(by_tag):
+                s = by_tag[t]
+                owned = await process.request(
+                    Endpoint(s.address, f"storage.ownedRanges#{s.uid}"),
+                    None,
+                )
+                for b, e in owned:
+                    cover.insert(b, e, ((s.address,), (t,)))
+            # gaps (a move mid-flight when the region died) fall back to
+            # the snapshot's assignment, re-pointed tag-for-tag
+            for b, e, _addrs, tags in shards:
+                for gb, ge, v in cover.intersecting(b, e):
+                    if v is None:
+                        cover.insert(
+                            gb,
+                            ge,
+                            (
+                                tuple(by_tag[t].address for t in tags),
+                                tags,
+                            ),
+                        )
+            for b, e, v in cover.ranges():
+                if v is not None:
+                    promoted_shards.append((b, e, v[0], v[1]))
+            shards = promoted_shards
+            shard_map = ShardMap.from_list(shards)
 
     n_storage = int(config.get("n_storage", 1))
     n_tlogs = int(config.get("n_tlogs", 1))
@@ -583,15 +696,16 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     # Best-effort: a dead old tlog's txs data dies with it anyway.
     from .interfaces import TLogPopRequest
 
-    for old in old_sets:
-        for log in old.set.logs:
-            process.spawn(
-                _pop_quietly(
-                    process,
-                    log.ep("pop"),
-                    TLogPopRequest(tag=TXS_TAG, upto=recovery_version),
+    if not failover_to:  # failover old sets are routers: no txs stream
+        for old in old_sets:
+            for log in old.set.logs:
+                process.spawn(
+                    _pop_quietly(
+                        process,
+                        log.ep("pop"),
+                        TLogPopRequest(tag=TXS_TAG, upto=recovery_version),
+                    )
                 )
-            )
 
     # FULLY_RECOVERED: publish
     info = ServerDBInfo(
@@ -613,7 +727,18 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                 old=old_router_sets,
             )
             if router_set is not None
-            else None
+            # promoted (remote-wired) storage follows router_config
+            # forever: every post-failover epoch mirrors the primary log
+            # system there
+            else (
+                LogSystemConfig(
+                    epoch=recovery_count,
+                    current=tlog_set,
+                    old=tuple(old_sets),
+                )
+                if config.get("failover_promoted")
+                else None
+            )
         ),
         remote_storage=tuple(remote_storage),
     )
@@ -949,7 +1074,13 @@ async def _track_tlog_recovery(process, cs, core, info, cc_address, storage):
                 epoch=core.recovery_count, current=core.router_set, old=()
             )
             if core.router_set is not None
-            else None
+            else (
+                LogSystemConfig(
+                    epoch=core.recovery_count, current=core.tlog_set, old=()
+                )
+                if core.config.get("failover_promoted")
+                else None
+            )
         ),
         remote_storage=tuple(core.remote_storage),
     )

@@ -802,6 +802,17 @@ class StorageServer:
         would come back with (old tlog generations must outlive it)."""
         return (self.version.get(), self.durable_version, self._followed_epoch)
 
+    async def _owned_ranges(self, _req) -> list:
+        """[(begin, end)] this server currently OWNS — its applied view of
+        the shard map. The failover promotion rebuilds the cluster shard
+        map from the mirrors' own state (the coordinated snapshot may
+        predate moves whose metadata relayed with the data)."""
+        return [
+            (b, e)
+            for b, e, state in self.owned.ranges()
+            if state is not None and state[0] == "owned"
+        ]
+
     async def _metrics(self, _req) -> dict:
         return self.stats.snapshot()
 
@@ -812,6 +823,7 @@ class StorageServer:
         process.register(f"storage.version#{self.uid}", self._get_version)
         process.register(f"storage.ping#{self.uid}", self._ping)
         process.register(f"storage.metrics#{self.uid}", self._metrics)
+        process.register(f"storage.ownedRanges#{self.uid}", self._owned_ranges)
         process.register(Tokens.GET_SHARD_STATE, self.get_shard_state)
         process.register(Tokens.GET_SHARD_METRICS, self.get_shard_metrics)
         process.register(Tokens.GET_SPLIT_KEY, self.get_split_key)

@@ -171,6 +171,16 @@ class FdbCli:
         n = await restore(self.db, self._container_for(name))
         return f"Restored {n} snapshot rows (+ mutation log)"
 
+    async def _cmd_force_failover(self, args) -> str:
+        """force_failover <dc> — promote a region after primary loss
+        (force_recovery_with_data_loss)."""
+        if not args:
+            return "ERROR: force_failover <dc>"
+        await management.force_failover(
+            self.coordinators, self.db.client, args[0]
+        )
+        return f"Failover to region `{args[0]}' initiated"
+
     async def _cmd_configure(self, args) -> str:
         changes = {}
         for a in args:
