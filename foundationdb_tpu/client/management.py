@@ -92,6 +92,9 @@ async def configure(db, coordinators: list[str], client, **changes) -> None:
     await force_recovery(coordinators, client)
 
 
+_TIMED_OUT = object()
+
+
 async def _leader_request(
     coordinators: list[str],
     client,
@@ -122,8 +125,11 @@ async def _leader_request(
                 reply = await _timeout(
                     client.request(Endpoint(cc.address, token), payload),
                     per_try_timeout,
+                    default=_TIMED_OUT,
                 )
-                if accept(reply):
+                # a timed-out try is a FAILED try, not an accepted None —
+                # the stale-leader case must fall through to rediscovery
+                if reply is not _TIMED_OUT and accept(reply):
                     return reply
             except Exception:
                 pass

@@ -23,6 +23,13 @@ import os
 from ..runtime.futures import delay
 
 
+class DiskFault(IOError):
+    """An injected io_error or disk-full (flow/FaultInjection.h:26,
+    sim2.actor.cpp:676 SimDiskSpace). Surfaces from SimFile ops; the
+    owning role treats it like any fatal disk error (role death →
+    recovery replaces it)."""
+
+
 class SimDisk:
     """All files of one simulated machine; survives process reboot."""
 
@@ -30,12 +37,42 @@ class SimDisk:
         self.sim = sim
         self.machine = machine
         self.files: dict[str, "SimFile"] = {}
+        # fault injection (machine-scoped, like the reference's per-
+        # machine io_error injection): probability an op raises, and an
+        # optional capacity that makes writes past it fail as disk-full
+        self.io_error_p = 0.0
+        self.capacity: int = None
 
     def open(self, path: str) -> "SimFile":
         f = self.files.get(path)
         if f is None:
-            f = self.files[path] = SimFile(self.sim, path)
+            f = self.files[path] = SimFile(self.sim, path, disk=self)
         return f
+
+    def total_bytes(self) -> int:
+        return sum(f.size() for f in self.files.values())
+
+    def inject_io_errors(self, p: float) -> None:
+        """Arm (p > 0) or disarm per-op io_error injection."""
+        self.io_error_p = p
+
+    def set_capacity(self, capacity) -> None:
+        """None = unlimited; otherwise writes that would grow the disk
+        past ``capacity`` bytes raise disk-full."""
+        self.capacity = capacity
+
+    def _maybe_fault(self, grew: int = 0) -> None:
+        if (
+            self.io_error_p > 0.0
+            and self.sim.loop.random.coinflip(self.io_error_p)
+        ):
+            raise DiskFault(f"injected io_error on {self.machine}")
+        if (
+            grew > 0
+            and self.capacity is not None
+            and self.total_bytes() + grew > self.capacity
+        ):
+            raise DiskFault(f"disk full on {self.machine}")
 
     def exists(self, path: str) -> bool:
         return path in self.files
@@ -59,9 +96,10 @@ class SimFile:
     SYNC_TIME = 0.0005  # modeled fsync
     WRITE_TIME = 0.00005
 
-    def __init__(self, sim, path: str):
+    def __init__(self, sim, path: str, disk: "SimDisk" = None):
         self.sim = sim
         self.path = path
+        self.disk = disk
         self._durable = bytearray()
         # unsynced ops in ISSUE ORDER: ("write", offset, bytes) |
         # ("trunc", size). One ordered list, replayed in sequence, so a
@@ -72,22 +110,30 @@ class SimFile:
 
     # -- IAsyncFile ------------------------------------------------------------
 
+    def _fault(self, grew: int = 0) -> None:
+        if self.disk is not None:
+            self.disk._maybe_fault(grew)
+
     async def write(self, offset: int, data: bytes) -> None:
         await delay(self.WRITE_TIME)
+        self._fault(grew=max(0, offset + len(data) - self.size()))
         self._pending_ops.append(("write", offset, bytes(data)))
 
     async def read(self, offset: int, length: int) -> bytes:
         await delay(self.WRITE_TIME)
+        self._fault()
         img = self._image()
         return bytes(img[offset : offset + length])
 
     async def sync(self) -> None:
         await delay(self.SYNC_TIME)
+        self._fault()
         self._durable = self._image()
         self._pending_ops = []
 
     async def truncate(self, size: int) -> None:
         await delay(self.WRITE_TIME)
+        self._fault()
         self._pending_ops.append(("trunc", size))
 
     def size(self) -> int:

@@ -258,7 +258,9 @@ class DynamicCluster:
 
 def _boot_coordinator(process):
     async def run():
-        CoordinatorServer().register(process)
+        CoordinatorServer(disk=process.sim.disk(process.machine)).register(
+            process
+        )
 
     return run()
 

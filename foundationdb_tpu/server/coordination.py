@@ -151,10 +151,103 @@ class CoordinatorServer:
     """One coordinator process: generation registers + leader registers,
     keyed by cluster key (coordinationServer, Coordination.actor.cpp:413)."""
 
-    def __init__(self):
+    def __init__(self, disk=None):
         self.registers: dict[str, _Register] = {}
         self.leaders: dict[str, _LeaderState] = {}
         self.process = None
+        # durable generation registers (the reference's OnDemandStore,
+        # Coordination.actor.cpp:125 localGenerationReg): without this a
+        # whole-cluster restart forgets the coordinated state and the
+        # tlogs' durable tail is never replayed — found by the
+        # restarting-test tier, which lost acked writes
+        self.disk = disk
+        self._persist_busy: Future = None
+        self._reg_seq: dict[str, int] = {}  # per-key slot sequence
+
+    @staticmethod
+    def _parse_slot(raw: bytes):
+        """(seq, decoded) from a slot record, or None when short/corrupt."""
+        import struct
+        import zlib
+
+        from ..net import wire
+
+        if len(raw) < 16:
+            return None
+        seq, length, crc = struct.unpack_from("<QII", raw, 0)
+        payload = raw[16 : 16 + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            return None
+        try:
+            return seq, wire.decode_value(bytes(payload))
+        except Exception:
+            return None
+
+    def _read_file(self, fname: str) -> bytes:
+        f = self.disk.open(fname)
+        if hasattr(f, "_image"):
+            return bytes(f._image())
+        with open(f.path, "rb") as fh:  # RealFile: synchronous boot read
+            return fh.read()
+
+    def _load(self) -> None:
+        keys = set()
+        for fname in self.disk.list():
+            if fname.startswith("coordreg-") and fname[-2:] in (".a", ".b"):
+                keys.add(fname[len("coordreg-"):-2])
+        for key in keys:
+            best = None
+            for slot in ("a", "b"):
+                fname = f"coordreg-{key}.{slot}"
+                if not self.disk.exists(fname):
+                    continue
+                parsed = self._parse_slot(self._read_file(fname))
+                if parsed and (best is None or parsed[0] > best[0]):
+                    best = parsed
+            if best is None:
+                continue
+            self._reg_seq[key] = best[0]
+            _key, value, read_gen, write_gen = best[1]
+            r = self._reg(key)
+            r.value, r.read_gen, r.write_gen = value, read_gen, write_gen
+
+    async def _persist(self, key: str) -> None:
+        """Durably record a register BEFORE replying (the promise/accept
+        of this register round must survive restart). TWO alternating
+        slot files with seq + checksum: a crash mid-write corrupts only
+        the slot being written, never the previously durable record (a
+        truncate-and-rewrite could durably lose a promised read_gen and
+        re-open the split-brain this persistence exists to prevent).
+        Serialized: slot rewrites must not interleave."""
+        if self.disk is None:
+            return
+        import struct
+        import zlib
+
+        from ..net import wire
+
+        while self._persist_busy is not None:
+            await self._persist_busy
+        self._persist_busy = Future()
+        try:
+            r = self._reg(key)
+            seq = self._reg_seq.get(key, 0) + 1
+            self._reg_seq[key] = seq
+            payload = wire.encode_value(
+                (key, r.value, r.read_gen, r.write_gen)
+            )
+            blob = (
+                struct.pack("<QII", seq, len(payload), zlib.crc32(payload))
+                + payload
+            )
+            slot = "a" if seq % 2 else "b"
+            f = self.disk.open(f"coordreg-{key}.{slot}")
+            await f.truncate(0)
+            await f.write(0, blob)
+            await f.sync()
+        finally:
+            busy, self._persist_busy = self._persist_busy, None
+            busy._set(None)
 
     # -- generation register (localGenerationReg:125) --------------------------
 
@@ -169,6 +262,7 @@ class CoordinatorServer:
         r = self._reg(req.key)
         if req.gen > r.read_gen:
             r.read_gen = req.gen
+            await self._persist(req.key)  # the PROMISE must survive restart
         return GenReadReply(value=r.value, write_gen=r.write_gen, read_gen=r.read_gen)
 
     async def gen_write(self, req: GenWriteRequest) -> GenWriteReply:
@@ -178,6 +272,7 @@ class CoordinatorServer:
             r.write_gen = req.gen
             if req.gen > r.read_gen:
                 r.read_gen = req.gen
+            await self._persist(req.key)  # accept durable before the ack
             return GenWriteReply(ok=True, read_gen=r.read_gen)
         return GenWriteReply(ok=False, read_gen=r.read_gen)
 
@@ -269,6 +364,8 @@ class CoordinatorServer:
 
     def register(self, process) -> None:
         self.process = process
+        if self.disk is not None:
+            self._load()
         process.register(Tokens.GEN_POLL, self.gen_poll)
         process.register(Tokens.GEN_READ, self.gen_read)
         process.register(Tokens.GEN_WRITE, self.gen_write)

@@ -108,7 +108,7 @@ def main(argv=None) -> int:
     if args.role == "coordinator":
         from ..server.coordination import CoordinatorServer
 
-        CoordinatorServer().register(world.node)
+        CoordinatorServer(disk=world.disk("coordination")).register(world.node)
     else:
         from ..server.worker import Worker
 

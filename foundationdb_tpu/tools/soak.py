@@ -44,9 +44,13 @@ from ..workloads import (
     AtomicOpsWorkload,
     AttritionWorkload,
     BackupWorkload,
+    ChangeConfigWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
+    DiskFailureWorkload,
     RandomCloggingWorkload,
+    RandomMoveKeysWorkload,
+    RollbackWorkload,
     RywFuzzWorkload,
     SerializabilityWorkload,
     SidebandWorkload,
@@ -125,6 +129,34 @@ def run_one(seed: int, verbose: bool = False) -> dict:
                 kills=kills,
                 interval=4.0,
                 protect=set(cluster.coordinators),
+            )
+        )
+    # chaos round 2 (Rollback / RandomMoveKeys / ChangeConfig / disk
+    # faults, fdbserver/workloads analogs) rotates in per seed
+    if shape_rng.coinflip(0.4):
+        workloads.append(
+            RollbackWorkload(db, rng.fork(), sim=sim, clogs=2, duration=1.5)
+        )
+    if shape_rng.coinflip(0.3):
+        workloads.append(
+            RandomMoveKeysWorkload(db, rng.fork(), sim=sim, moves=2)
+        )
+    if shape_rng.coinflip(0.25):
+        workloads.append(
+            ChangeConfigWorkload(
+                db, rng.fork(), coordinators=cluster.coordinators, changes=1
+            )
+        )
+    if shape_rng.coinflip(0.3) and cfg.replication > 1:
+        workloads.append(
+            DiskFailureWorkload(
+                db,
+                rng.fork(),
+                sim=sim,
+                episodes=1,
+                duration=1.5,
+                p=0.03,
+                disk_full=shape_rng.coinflip(0.3),
             )
         )
     workloads.append(

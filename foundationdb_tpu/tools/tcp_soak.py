@@ -126,6 +126,25 @@ class TcpCluster:
         manifests (worker._rescan_disk)."""
         self.procs[name] = spawn_server(self.spawn_args[name])
 
+    def kill_all(self):
+        """SIGKILL the whole process tree, keeping every datadir — the
+        restarting-test tier's save-and-kill (SaveAndKill.actor.cpp)."""
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def restart_all(self):
+        """Relaunch the ENTIRE cluster on the same ports + datadirs:
+        coordinators recover the cstate, durable roles resurrect from
+        manifests, and a recovery re-forms the database."""
+        for name, args in self.spawn_args.items():
+            self.procs[name] = spawn_server(args)
+
     def stop(self):
         for p in self.procs.values():
             if p.poll() is None:

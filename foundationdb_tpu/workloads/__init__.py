@@ -54,3 +54,9 @@ from .ryw_fuzz import RywFuzzWorkload  # noqa: E402,F401
 from .atomic_ops import AtomicOpsWorkload  # noqa: E402,F401
 from .watches import WatchesWorkload  # noqa: E402,F401
 from .backup_workload import BackupWorkload  # noqa: E402,F401
+from .chaos_extra import (  # noqa: E402,F401
+    ChangeConfigWorkload,
+    DiskFailureWorkload,
+    RandomMoveKeysWorkload,
+    RollbackWorkload,
+)

@@ -1,0 +1,190 @@
+"""Chaos round 2: io_error/disk-full injection, Rollback,
+RandomMoveKeys, ChangeConfig under load, and the restarting test tier
+(whole-cluster save-and-kill over real processes)."""
+
+import pytest
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.files import DiskFault, SimDisk
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import spawn
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.workloads import (
+    ChangeConfigWorkload,
+    ConsistencyCheckWorkload,
+    CycleWorkload,
+    DiskFailureWorkload,
+    RandomMoveKeysWorkload,
+    RollbackWorkload,
+    run_workloads,
+)
+
+
+def make(seed=0, **cfg):
+    sim = Sim(seed=seed, chaos=True)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(**cfg), n_coordinators=3
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    return sim, cluster, db
+
+
+# -- fault primitives ---------------------------------------------------------
+
+
+def test_sim_disk_io_error_injection():
+    sim = Sim(seed=1)
+    sim.activate()
+    disk = SimDisk(sim, "m1")
+    disk.inject_io_errors(1.0)
+    f = disk.open("x")
+
+    async def go():
+        with pytest.raises(DiskFault):
+            await f.write(0, b"data")
+        disk.inject_io_errors(0.0)
+        await f.write(0, b"data")
+        await f.sync()
+        assert await f.read(0, 4) == b"data"
+        return True
+
+    assert sim.run_until_done(spawn(go()), 10.0)
+
+
+def test_sim_disk_full():
+    sim = Sim(seed=2)
+    sim.activate()
+    disk = SimDisk(sim, "m2")
+    f = disk.open("x")
+
+    async def go():
+        await f.write(0, b"a" * 100)
+        await f.sync()
+        disk.set_capacity(disk.total_bytes() + 10)
+        await f.write(100, b"b" * 10)  # exactly fits
+        with pytest.raises(DiskFault):
+            await f.write(110, b"c" * 50)  # over capacity
+        disk.set_capacity(None)
+        await f.write(110, b"c" * 50)
+        return True
+
+    assert sim.run_until_done(spawn(go()), 10.0)
+
+
+# -- workloads under load -----------------------------------------------------
+
+
+def _spec(db, sim, rng, fault_workloads):
+    return [
+        CycleWorkload(db, rng.fork(), nodes=10, transactions=20),
+        *fault_workloads,
+        ConsistencyCheckWorkload(db, rng.fork(), replication=2),
+    ]
+
+
+def drive_spec(sim, workloads, limit=1200.0):
+    async def go():
+        await run_workloads(workloads)
+        return True
+
+    assert sim.run_until_done(spawn(go()), limit)
+
+
+def test_rollback_under_load():
+    sim, cluster, db = make(
+        seed=11, n_proxies=2, n_tlogs=2, n_storage=2, replication=2,
+        tlog_replication=2,
+    )
+    rng = sim.loop.random
+    w = RollbackWorkload(db, rng.fork(), sim=sim, clogs=2, duration=1.5)
+    drive_spec(sim, _spec(db, sim, rng, [w]))
+    assert w.performed >= 1
+
+
+def test_random_move_keys_under_load():
+    sim, cluster, db = make(
+        seed=12, n_storage=4, replication=2, n_tlogs=2, tlog_replication=2
+    )
+    rng = sim.loop.random
+    w = RandomMoveKeysWorkload(db, rng.fork(), sim=sim, moves=3)
+    drive_spec(sim, _spec(db, sim, rng, [w]))
+    assert w.attempts >= 1
+
+
+def test_change_config_under_load():
+    sim, cluster, db = make(
+        seed=13, n_proxies=1, n_resolvers=1, n_storage=2, replication=2,
+        n_tlogs=2, tlog_replication=2,
+    )
+    rng = sim.loop.random
+    w = ChangeConfigWorkload(
+        db, rng.fork(), coordinators=cluster.coordinators, changes=1,
+        choices=[{"n_proxies": 2}],
+    )
+    drive_spec(sim, _spec(db, sim, rng, [w]))
+    assert w.changed >= 1
+
+
+def test_disk_failure_under_load():
+    sim, cluster, db = make(
+        seed=14, n_storage=2, replication=2, n_tlogs=2, tlog_replication=2
+    )
+    rng = sim.loop.random
+    w = DiskFailureWorkload(
+        db, rng.fork(), sim=sim, episodes=1, duration=1.5, p=0.05
+    )
+    drive_spec(sim, _spec(db, sim, rng, [w]))
+    assert w.faulted
+
+
+# -- restarting tier (real processes) -----------------------------------------
+
+
+def test_tcp_cluster_save_kill_restart():
+    """SaveAndKill.actor.cpp's shape over real processes: write, SIGKILL
+    the whole tree, restart it on the same datadirs/ports, verify
+    everything synced before the kill survives, and keep writing."""
+    import tempfile
+
+    from foundationdb_tpu.tools.tcp_soak import TcpCluster, fdbcli, wait_for
+
+    with tempfile.TemporaryDirectory(prefix="restart-tier-") as d:
+        cluster = TcpCluster(d)
+        try:
+            wait_for(
+                lambda: (
+                    fdbcli(cluster.coord, "set boot ok", timeout=30)[0] == 0,
+                    "boot",
+                ),
+                180,
+                "cluster never formed",
+                cluster,
+            )
+            for i in range(8):
+                rc, out = fdbcli(
+                    cluster.coord, f"set rk{i} v{i}", timeout=30
+                )
+                assert rc == 0, out
+
+            cluster.kill_all()
+            cluster.restart_all()
+
+            wait_for(
+                lambda: (
+                    fdbcli(cluster.coord, "set reborn ok", timeout=30)[0]
+                    == 0,
+                    "reform",
+                ),
+                180,
+                "cluster never re-formed after full restart",
+                cluster,
+            )
+            rc, out = fdbcli(
+                cluster.coord, *[f"get rk{i}" for i in range(8)], timeout=60
+            )
+            assert rc == 0, out
+            for i in range(8):
+                assert f"v{i}" in out, f"lost rk{i} after full restart:\n{out}"
+        finally:
+            cluster.stop()
