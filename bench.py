@@ -100,6 +100,24 @@ def bench_range_index():
     host_qps = rounds * batch / host_dt
     log(f"host bisect: {host_dt:.2f}s, {host_qps/1e6:.3f} M lookups/s")
     assert hits == host_hits, (hits, host_hits)
+
+    # incremental maintenance: the per-epoch delta-merge the storage path
+    # uses vs a full rebuild (the round-4 weak spot: O(N) per epoch)
+    delta_add = [b"%012d" % rnd.randrange(10**12) for _ in range(1000)]
+    delta_del = rnd.sample(keys, 1000)
+    t0 = time.time()
+    idx2 = idx.apply_delta(delta_add, delta_del)
+    delta_dt = time.time() - t0
+    t0 = time.time()
+    keys2 = sorted(set(keys) - set(delta_del) | set(delta_add))
+    TpuRangeIndex(keys2)
+    rebuild_dt = time.time() - t0
+    log(
+        f"epoch update (1K adds + 1K dels over {len(keys)} keys): "
+        f"delta {delta_dt*1000:.1f} ms vs full rebuild "
+        f"{rebuild_dt*1000:.1f} ms ({rebuild_dt/max(delta_dt,1e-9):.0f}x)"
+    )
+    assert idx2.n == len(keys2)
     print(
         json.dumps(
             {

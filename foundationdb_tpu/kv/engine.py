@@ -36,6 +36,14 @@ class KeyValueStoreMemory:
         self._ops = BinaryWriter()
         self._ops_count = 0
         self._oplog_bytes = 0  # op-log bytes since the last snapshot
+        # key → was it present BEFORE its first touch this epoch? The
+        # storage's TPU range index delta-merges from the EXACT diff
+        # (present-before vs present-after), so add+clear-within-an-epoch
+        # nets out instead of corrupting the index. Tracking is off until
+        # the index consumer enables it (a real server with the index
+        # disabled must not leak touched keys forever).
+        self.track_dirty = False
+        self.dirty_keys: dict = {}
 
     # -- recovery --------------------------------------------------------------
 
@@ -77,6 +85,8 @@ class KeyValueStoreMemory:
 
     def set(self, key: bytes, value: bytes) -> None:
         if key not in self._map:
+            if self.track_dirty:
+                self.dirty_keys.setdefault(key, False)
             bisect.insort(self._keys, key)
         self._map[key] = value
         self._ops.u8(_OP_SET).bytes_(key).bytes_(value)
@@ -87,6 +97,8 @@ class KeyValueStoreMemory:
         hi = bisect.bisect_left(self._keys, end)
         for k in self._keys[lo:hi]:
             del self._map[k]
+            if self.track_dirty:
+                self.dirty_keys.setdefault(k, True)
         del self._keys[lo:hi]
         self._ops.u8(_OP_CLEAR).bytes_(begin).bytes_(end)
         self._ops_count += 1
@@ -120,6 +132,16 @@ class KeyValueStoreMemory:
         self._oplog_bytes = 0
 
     # -- reads -----------------------------------------------------------------
+
+    def take_dirty(self):
+        """(added, removed): the exact key diff since the last call —
+        keys absent before and present now, and vice versa. Keys that
+        net out (add+clear, clear+re-add within the window) appear in
+        neither."""
+        d, self.dirty_keys = self.dirty_keys, {}
+        added = [k for k, was in d.items() if not was and k in self._map]
+        removed = [k for k, was in d.items() if was and k not in self._map]
+        return added, removed
 
     def read_value(self, key: bytes):
         return self._map.get(key)

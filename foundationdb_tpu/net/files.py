@@ -116,7 +116,12 @@ class SimFile:
 
     async def write(self, offset: int, data: bytes) -> None:
         await delay(self.WRITE_TIME)
-        self._fault(grew=max(0, offset + len(data) - self.size()))
+        if self.disk is not None and self.disk.capacity is not None:
+            # size() replays every pending op — only pay for it when a
+            # disk-full window is actually armed
+            self._fault(grew=max(0, offset + len(data) - self.size()))
+        else:
+            self._fault()
         self._pending_ops.append(("write", offset, bytes(data)))
 
     async def read(self, offset: int, length: int) -> bytes:

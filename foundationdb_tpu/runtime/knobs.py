@@ -30,7 +30,10 @@ class Knobs:
     # storage
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_FETCH_KEYS_BATCH = 10_000
-    STORAGE_TPU_INDEX = False  # TPU batched-read snapshot index
+    # TPU batched-read snapshot index on the storage read path
+    # (SURVEY.md's secondary target): default ON — it serves batch_get
+    # misses and getRange bounds, delta-merged each durability epoch
+    STORAGE_TPU_INDEX = True
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
     # multi-region log routing

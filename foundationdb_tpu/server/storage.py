@@ -536,11 +536,23 @@ class StorageServer:
             i += 1
         del q[:i]
         self.engine.set(b"\xff\xff/local/meta", self._encode_local_meta(new_durable))
-        await self.engine.commit()
         if getattr(self.knobs, "STORAGE_TPU_INDEX", False):
+            # update the index BEFORE the commit await: the drain above
+            # mutated the engine synchronously, and a read interleaving
+            # during the fsync must see index and key list in lockstep
             from ..ops.range_index import TpuRangeIndex
 
-            self._range_index = TpuRangeIndex(list(self.engine._keys))
+            if self._range_index is None:
+                self.engine.track_dirty = True
+                self.engine.take_dirty()  # discard pre-index history
+                self._range_index = TpuRangeIndex(list(self.engine._keys))
+            else:
+                added, removed = self.engine.take_dirty()
+                if added or removed:
+                    self._range_index = self._range_index.apply_delta(
+                        added, removed
+                    )
+        await self.engine.commit()
 
     def _encode_local_meta(self, durable: Version) -> bytes:
         import json
@@ -664,7 +676,7 @@ class StorageServer:
         overlay = dict(win)
         want = limit + len(win) + 1
         while True:
-            base = self.engine.read_range(begin, end, limit=want)
+            base = self._engine_range(begin, end, want)
             # the engine's local metadata rows (\xff\xff/local/...) are
             # not data — they must not leak into client scans or fetchKeys
             merged = {
@@ -685,6 +697,38 @@ class StorageServer:
             if len(rows) >= limit or exhausted:
                 return rows[:limit]
             want *= 2
+
+    def _engine_range(self, begin, end, want):
+        """Durable-engine range rows, routed through the TPU range index
+        when it is on (the snapshot's [lo, hi) row bounds come from the
+        batched searchsorted kernel; rows materialize from the engine's
+        sorted key list) — getRange coverage for the read-path index,
+        falling back to the engine's own bisect otherwise.
+
+        Codes are truncated, so the index bounds are approximate at code
+        collisions: lo never overshoots (order-preserving codes) but the
+        slice may include colliding keys below ``begin`` and the hi bound
+        may cut a collision run short — the slice is extended through the
+        run and post-filtered against the REAL byte keys."""
+        idx = self._range_index
+        keys_list = self.engine._keys
+        if idx is not None and idx.n == len(keys_list):
+            lo, hi = idx.batch_range([begin], [end])
+            lo, hi = int(lo[0]), int(hi[0])
+            out = []
+            j = lo
+            n = len(keys_list)
+            while j < n and (j < hi or keys_list[j] < end):
+                k = keys_list[j]
+                if k >= end:
+                    break
+                if k >= begin:
+                    out.append((k, self.engine._map[k]))
+                    if len(out) >= want:
+                        break
+                j += 1
+            return out
+        return self.engine.read_range(begin, end, limit=want)
 
     async def batch_get(self, req):
         """Many point reads in ONE request: window hits answer locally;

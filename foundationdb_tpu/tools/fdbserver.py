@@ -85,6 +85,12 @@ def main(argv=None) -> int:
                 parsed = val
         knob_overrides[name.upper()] = parsed
     knobs = Knobs(**knob_overrides)
+    if "STORAGE_TPU_INDEX" not in knob_overrides:
+        # default-on applies to sim-CPU runs; a real server process must
+        # not lazily initialize JAX per durability epoch (on a shared
+        # tunnel host that can hang outright) unless the operator opts in
+        # via --knob storage_tpu_index=1
+        knobs.STORAGE_TPU_INDEX = False
 
     tls = None
     if args.tls_cert or args.tls_key or args.tls_ca:
