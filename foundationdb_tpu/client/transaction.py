@@ -76,6 +76,13 @@ class Transaction:
         self._watches: list[tuple[bytes, object]] = []  # (key, Future)
         self.committed_version: Optional[int] = None
         self.versionstamp: Optional[bytes] = None
+        # transaction-debug attach id (fdb_transaction_set_option
+        # DEBUG_TRANSACTION_IDENTIFIER + the commit sampler): every
+        # pipeline stage traces CommitDebug events with it
+        self.debug_id: str = ""
+
+    def set_debug_id(self, debug_id: str) -> None:
+        self.debug_id = debug_id
 
     # -- read version ----------------------------------------------------------
 
@@ -343,12 +350,24 @@ class Transaction:
             self.committed_version = self._read_version or 0
             self._start_watches()
             return self.committed_version
+        if not self.debug_id and self.db.rng.random01() < getattr(
+            self.db.knobs, "CLIENT_COMMIT_SAMPLE", 0.0
+        ):
+            self.debug_id = f"txn-{self.db.rng.random_unique_id()}"
         data = TransactionData(
             read_snapshot=await self.get_read_version() if self._rcr else 0,
             read_conflict_ranges=_dedup(self._rcr),
             write_conflict_ranges=_dedup(self._wcr),
             mutations=self._mutations,
+            debug_id=self.debug_id,
         )
+        if self.debug_id:
+            from ..runtime.trace import SevInfo, trace
+
+            trace(
+                SevInfo, "CommitDebug", "client",
+                Id=self.debug_id, Event="ClientCommitStart",
+            )
         if buggify():
             await delay(0.002)  # commit racing a concurrent writer
         try:
@@ -361,6 +380,13 @@ class Transaction:
             raise CommitUnknownResult()
         self.committed_version = reply.version
         self.versionstamp = reply.versionstamp
+        if self.debug_id:
+            from ..runtime.trace import SevInfo, trace
+
+            trace(
+                SevInfo, "CommitDebug", "client",
+                Id=self.debug_id, Event="ClientCommitDone",
+            )
         self._start_watches()
         return reply.version
 

@@ -40,8 +40,11 @@ class TpuRangeIndex:
             codes = _codes
         else:
             codes = K.encode_keys(list(keys), width=width)  # lane-packed
-        self.n = codes.shape[0] if hasattr(codes, "shape") else len(keys)
-        self._codes_np = np.asarray(codes).reshape(self.n, -1)
+        codes = np.asarray(codes)
+        if codes.ndim != 2:  # empty key set: reshape(0, -1) would raise
+            codes = codes.reshape(0, width // 4)
+        self.n = codes.shape[0]
+        self._codes_np = codes
         # pad to a power of two with the max sentinel so searchsorted
         # stays in-bounds with static shapes
         cap = 1

@@ -31,9 +31,12 @@ class Knobs:
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_FETCH_KEYS_BATCH = 10_000
     # TPU batched-read snapshot index on the storage read path
-    # (SURVEY.md's secondary target): default ON — it serves batch_get
-    # misses and getRange bounds, delta-merged each durability epoch
-    STORAGE_TPU_INDEX = True
+    # (SURVEY.md's secondary target): serves batch_get misses and
+    # getRange bounds, delta-merged each durability epoch. None = AUTO:
+    # on under simulation loops, off on a RealLoop (a real server must
+    # not lazily initialize JAX per durability epoch on a shared-tunnel
+    # host that can hang); True/False force it either way.
+    STORAGE_TPU_INDEX = None
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
     # multi-region log routing
@@ -58,6 +61,9 @@ class Knobs:
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
     # client
+    # fraction of commits auto-tagged with a transaction-debug id
+    # (g_traceBatch sampling; tr.set_debug_id forces one)
+    CLIENT_COMMIT_SAMPLE = 0.0
     GRV_BATCH_INTERVAL = 0.0005
     CLIENT_MAX_RETRY_DELAY = 1.0
     # simulation (Sim2's latency model: MIN + FAST·a almost always, rare

@@ -30,15 +30,21 @@ async def system_monitor(process, interval: float = 5.0):
             rss_kb = 0
         coll = getattr(process, "actors", None)
         n_actors = len(getattr(coll, "_actors", []) or [])
-        trace(
-            SevInfo,
-            "ProcessMetrics",
-            getattr(process, "address", ""),
+        sample = dict(
             Elapsed=round(now() - last, 3),
             RunLoopLag=round(lag, 6),
             Actors=n_actors,
             Endpoints=len(getattr(process, "endpoints", {}) or {}),
             QueueDepth=len(getattr(loop, "_queue", []) or []),
             MemoryKB=rss_kb,
+        )
+        # latest sample stays readable on demand (the status document's
+        # machine/process sections pull it through worker.systemMetrics)
+        process.last_process_metrics = sample
+        trace(
+            SevInfo,
+            "ProcessMetrics",
+            getattr(process, "address", ""),
+            **sample,
         )
         last = now()

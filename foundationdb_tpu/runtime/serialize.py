@@ -17,7 +17,8 @@ import struct
 # handshake instead of raising mid-stream.
 # gen 2: GetCommitVersionRequest.applied_changes_version +
 #        GetCommitVersionReply.resolver_changes[,_version]
-PROTOCOL_VERSION = 0x0FDB00B070010002
+# gen 3: TransactionData.debug_id (transaction debug chains)
+PROTOCOL_VERSION = 0x0FDB00B070010003
 
 
 class BinaryWriter:

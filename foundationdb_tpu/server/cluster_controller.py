@@ -317,26 +317,50 @@ class ClusterController:
 
         # per-process role metrics (parallel pulls; a dead worker times out
         # without stalling the document)
-        async def pull(address):
+        async def pull_one(address, token):
             try:
-                m = await timeout(
-                    self.process.request(
-                        Endpoint(address, "worker.metrics"), None
-                    ),
-                    1.0,
+                return await timeout(
+                    self.process.request(Endpoint(address, token), None), 1.0
                 )
-                return address, m
             except Exception:
-                return address, None
+                return None
+
+        async def pull(address):
+            # concurrent + independent: one endpoint failing/slow must not
+            # discard the other's answer
+            mf = self.process.spawn(pull_one(address, "worker.metrics"))
+            sf = self.process.spawn(
+                pull_one(address, "worker.systemMetrics")
+            )
+            return address, await mf, await sf
 
         from ..runtime.futures import wait_for_all
 
         pulls = await wait_for_all(
             [self.process.spawn(pull(a)) for a in workers]
         )
-        for address, metrics in pulls:
+        # machine/process sections (Status.actor.cpp processStatus /
+        # machineStatus): the SystemMonitor vitals per process, rolled up
+        # per machine
+        processes = {}
+        for address, metrics, sysm in pulls:
             if metrics:
                 workers[address]["metrics"] = metrics
+            if sysm:
+                processes[address] = sysm
+        doc["processes"] = processes
+        machines: dict = {}
+        for address, sysm in processes.items():
+            mkey = workers[address].get("machine") or address
+            m = machines.setdefault(
+                mkey, {"processes": 0, "memory_kb": 0, "worst_run_loop_lag": 0.0}
+            )
+            m["processes"] += 1
+            m["memory_kb"] += sysm.get("MemoryKB") or 0
+            m["worst_run_loop_lag"] = max(
+                m["worst_run_loop_lag"], sysm.get("RunLoopLag") or 0.0
+            )
+        doc["machines"] = machines
 
         # aggregate sections (Status.actor.cpp's qos/data summaries).
         # Gauges may snapshot as None on a transient error — treat as 0.

@@ -32,6 +32,11 @@ class TransactionData:
     read_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
     write_conflict_ranges: list[tuple[bytes, bytes]] = field(default_factory=list)
     mutations: list[Mutation] = field(default_factory=list)
+    # sampled transaction-debug attach id (g_traceBatch,
+    # MasterProxyServer.actor.cpp:345): every pipeline stage emits a
+    # CommitDebug trace event carrying it, so one id reconstructs where a
+    # commit's latency went across client→proxy→resolver→tlog
+    debug_id: str = ""
 
 
 # -- master (version assignment; masterserver.actor.cpp:763 getVersion) -------

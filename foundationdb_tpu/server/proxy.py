@@ -614,6 +614,24 @@ class Proxy:
     async def _commit_batch(self, batch, local_n, vfut, vdeadline):
         txns = [t for t, _ in batch]
         replies = [f for _, f in batch]
+        debug_ids = [
+            t.debug_id for t in txns if getattr(t, "debug_id", "")
+        ]
+
+        def _debug(event):
+            # transaction-debug chains (g_traceBatch,
+            # MasterProxyServer.actor.cpp:345): one event per sampled txn
+            # per pipeline phase
+            if debug_ids:
+                from ..runtime.trace import SevInfo, trace
+
+                for did in debug_ids:
+                    trace(
+                        SevInfo, "CommitDebug", self.process.address,
+                        Id=did, Event=event, Proxy=self.uid,
+                    )
+
+        _debug("ProxyReceived")
 
         # phase 1 (ordered): version assignment + send resolve requests.
         # Ordering phase 1 per proxy makes this proxy's commit versions
@@ -642,6 +660,7 @@ class Proxy:
                 )
             self._apply_resolver_changes(vreq)
             prev_version, version = vreq.prev_version, vreq.version
+            _debug("GotCommitVersion")
             resolve_futs, resolve_meta = self._send_resolve(
                 prev_version, version, txns
             )
@@ -655,6 +674,7 @@ class Proxy:
         t_p2 = now()
         resolutions = await wait_for_all(resolve_futs)
         self._l_p2.add(now() - t_p2)
+        _debug("Resolved")
         verdicts = [Verdict.COMMITTED] * len(txns)
         for idxs, reply in zip(resolve_meta, resolutions):
             for i, v in zip(idxs, reply.committed):
@@ -733,6 +753,7 @@ class Proxy:
             known_committed=self.committed_version,
         )
         self._l_p4.add(now() - t_p4)
+        _debug("Logged")
 
         # phase 5: make the commit visible locally, then reply — the
         # master report is ASYNC (the reference replies straight after
@@ -760,6 +781,7 @@ class Proxy:
             v == Verdict.COMMITTED for v in verdicts
         ):
             oracle.note_acked(version)
+        _debug("Replied")
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
                 self._c_txn_committed.add()
@@ -866,6 +888,7 @@ class Proxy:
                             read_conflict_ranges=rcr,
                             write_conflict_ranges=wcr,
                             mutations=state_muts,
+                            debug_id=getattr(t, "debug_id", ""),
                         )
                     )
 

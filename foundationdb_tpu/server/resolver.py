@@ -162,6 +162,14 @@ class Resolver:
             for t in req.transactions
         ]
         self._sample_load(req.transactions)
+        for t in req.transactions:
+            if getattr(t, "debug_id", ""):
+                from ..runtime.trace import SevInfo, trace
+
+                trace(
+                    SevInfo, "CommitDebug", "",
+                    Id=t.debug_id, Event="Resolving", Resolver=self.uid,
+                )
         if buggify():
             await delay(0.001)  # slow resolver (pipeline under jitter)
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS

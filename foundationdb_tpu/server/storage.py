@@ -536,7 +536,7 @@ class StorageServer:
             i += 1
         del q[:i]
         self.engine.set(b"\xff\xff/local/meta", self._encode_local_meta(new_durable))
-        if getattr(self.knobs, "STORAGE_TPU_INDEX", False):
+        if self._index_enabled():
             # update the index BEFORE the commit await: the drain above
             # mutated the engine synchronously, and a read interleaving
             # during the fsync must see index and key list in lockstep
@@ -697,6 +697,14 @@ class StorageServer:
             if len(rows) >= limit or exhausted:
                 return rows[:limit]
             want *= 2
+
+    def _index_enabled(self) -> bool:
+        flag = getattr(self.knobs, "STORAGE_TPU_INDEX", None)
+        if flag is not None:
+            return bool(flag)
+        from ..runtime.loop import RealLoop, current_loop
+
+        return not isinstance(current_loop(), RealLoop)
 
     def _engine_range(self, begin, end, want):
         """Durable-engine range rows, routed through the TPU range index

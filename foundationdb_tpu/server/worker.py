@@ -78,6 +78,10 @@ class Worker:
         p.register(Tokens.WORKER_PING, self._ping)
         p.register(Tokens.WORKER_DESTROY_ROLE, self._destroy_role_req)
         p.register("worker.metrics", self._role_metrics)
+        p.register("worker.systemMetrics", self._system_metrics)
+        from ..runtime.monitor import system_monitor
+
+        p.spawn(system_monitor(p, interval=2.0))
         p.spawn(self._rescan_disk())  # reboot: resurrect durable roles
         p.spawn(monitor_leader(p, self.coordinators, self.leader))
         p.spawn(self._registration_client())
@@ -123,6 +127,11 @@ class Worker:
 
     async def _ping(self, _req):
         return "pong"
+
+    async def _system_metrics(self, _req) -> dict:
+        """The SystemMonitor's latest ProcessMetrics sample (status's
+        machine/process sections, Status.actor.cpp's processStatus)."""
+        return dict(getattr(self.process, "last_process_metrics", {}) or {})
 
     async def _role_metrics(self, _req) -> dict:
         """Snapshot every hosted role's CounterCollection — the status

@@ -112,6 +112,39 @@ class FdbCli:
         proxies = doc.get("client", {}).get("proxies")
         if proxies:
             lines.append(f"Proxies: {', '.join(proxies)}")
+        if args and args[0] == "details":
+            # machine/process sections (fdbcli `status details`)
+            machines = doc.get("machines", {})
+            if machines:
+                lines.append("")
+                lines.append(f"{len(machines)} machines:")
+                for m, info in sorted(machines.items()):
+                    lines.append(
+                        f"  {m}: {info['processes']} processes, "
+                        f"{info['memory_kb'] / 1024:.0f} MB, worst loop lag "
+                        f"{info['worst_run_loop_lag'] * 1000:.1f} ms"
+                    )
+            procs = doc.get("processes", {})
+            if procs:
+                lines.append("")
+                lines.append(f"{len(procs)} processes:")
+                for a, sm in sorted(procs.items()):
+                    roles = ",".join(
+                        doc["cluster"]["workers"].get(a, {}).get("roles", [])
+                    )
+                    lines.append(
+                        f"  {a:24s} lag {1000 * (sm.get('RunLoopLag') or 0):6.2f} ms  "
+                        f"actors {sm.get('Actors', '?'):>4}  "
+                        f"mem {((sm.get('MemoryKB') or 0) / 1024):6.0f} MB  "
+                        f"[{roles}]"
+                    )
+            data = doc.get("data") or {}
+            if data:
+                lines.append("")
+                lines.append(
+                    "Data: storage version spread "
+                    f"{data.get('storage_version_spread', 0)}"
+                )
         return "\n".join(lines)
 
     async def _cmd_exclude(self, args) -> str:

@@ -1,0 +1,71 @@
+"""Transaction debug chains: assemble where a sampled commit's time went.
+
+The analog of reading g_traceBatch's CommitDebug attach-id events
+(MasterProxyServer.actor.cpp:345-358, Resolver.actor.cpp:83) back into a
+latency breakdown. Every pipeline stage traces
+``CommitDebug Id=<id> Event=<stage>``; ``chain()`` collects one id's
+events in time order with per-hop deltas, ``format_chain()`` renders the
+breakdown a human reads to see where the milliseconds went.
+
+In simulation all processes share one TraceLog, so the chain assembles
+directly; for real clusters pass the merged events from the per-process
+trace files (each fdbserver writes --tracefile JSON lines).
+"""
+
+from __future__ import annotations
+
+from ..runtime.trace import trace_log
+
+STAGE_ORDER = [
+    "ClientCommitStart",
+    "ProxyReceived",
+    "GotCommitVersion",
+    "Resolving",
+    "Resolved",
+    "Logged",
+    "Replied",
+    "ClientCommitDone",
+]
+
+
+def chain(debug_id: str, events: list = None) -> list[dict]:
+    """Time-ordered CommitDebug events for one id (ties broken by
+    pipeline stage order)."""
+    evs = events if events is not None else trace_log().events
+    rank = {s: i for i, s in enumerate(STAGE_ORDER)}
+    out = [
+        e
+        for e in evs
+        if e.get("Type") == "CommitDebug" and e.get("Id") == debug_id
+    ]
+    out.sort(key=lambda e: (e["Time"], rank.get(e.get("Event"), 99)))
+    return out
+
+
+def format_chain(debug_id: str, events: list = None) -> str:
+    evs = chain(debug_id, events)
+    if not evs:
+        return f"no CommitDebug events for id {debug_id!r}"
+    t0 = evs[0]["Time"]
+    prev = t0
+    lines = [f"commit {debug_id}: {((evs[-1]['Time'] - t0) * 1000):.3f} ms total"]
+    for e in evs:
+        where = e.get("Proxy") or e.get("Resolver") or e.get("Machine") or ""
+        lines.append(
+            f"  +{(e['Time'] - t0) * 1000:7.3f} ms "
+            f"(Δ {(e['Time'] - prev) * 1000:6.3f}) "
+            f"{e.get('Event', '?'):18s} {where}"
+        )
+        prev = e["Time"]
+    return "\n".join(lines)
+
+
+def sampled_ids(events: list = None) -> list[str]:
+    """Every debug id seen, in first-appearance order."""
+    evs = events if events is not None else trace_log().events
+    seen, out = set(), []
+    for e in evs:
+        if e.get("Type") == "CommitDebug" and e.get("Id") not in seen:
+            seen.add(e["Id"])
+            out.append(e["Id"])
+    return out

@@ -85,12 +85,6 @@ def main(argv=None) -> int:
                 parsed = val
         knob_overrides[name.upper()] = parsed
     knobs = Knobs(**knob_overrides)
-    if "STORAGE_TPU_INDEX" not in knob_overrides:
-        # default-on applies to sim-CPU runs; a real server process must
-        # not lazily initialize JAX per durability epoch (on a shared
-        # tunnel host that can hang outright) unless the operator opts in
-        # via --knob storage_tpu_index=1
-        knobs.STORAGE_TPU_INDEX = False
 
     tls = None
     if args.tls_cert or args.tls_key or args.tls_ca:
@@ -129,10 +123,13 @@ def main(argv=None) -> int:
             knobs=knobs,
         ).start()
 
-    # SystemMonitor: periodic ProcessMetrics trace (flow/SystemMonitor.cpp)
-    from ..runtime.monitor import system_monitor
+    if args.role == "coordinator":
+        # workers spawn their own SystemMonitor (Worker.start); only the
+        # coordinator role needs one here — two loops would alternately
+        # overwrite last_process_metrics
+        from ..runtime.monitor import system_monitor
 
-    world.node.spawn(system_monitor(world.node))
+        world.node.spawn(system_monitor(world.node))
 
     print(f"fdbserver: {args.role} listening on {args.listen}", flush=True)
     try:

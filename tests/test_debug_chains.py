@@ -1,0 +1,99 @@
+"""Transaction debug chains + status machine/process sections
+(g_traceBatch attach ids, MasterProxyServer.actor.cpp:345; Status's
+processStatus sections)."""
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.tools.commit_chain import chain, format_chain, sampled_ids
+
+
+def test_commit_debug_chain_covers_every_stage():
+    sim = Sim(seed=51)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_proxies=2, n_resolvers=2), n_coordinators=1
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def go():
+        tr = db.transaction()
+        tr.set_debug_id("probe-1")
+        await tr.get(b"warm")  # pins a read version (GRV in the chain)
+        tr.set(b"dbg", b"v")
+        await tr.commit()
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    evs = chain("probe-1")
+    stages = [e["Event"] for e in evs]
+    for must in (
+        "ClientCommitStart",
+        "ProxyReceived",
+        "GotCommitVersion",
+        "Resolving",
+        "Resolved",
+        "Logged",
+        "Replied",
+        "ClientCommitDone",
+    ):
+        assert must in stages, (must, stages)
+    # time-ordered with a sane total
+    times = [e["Time"] for e in evs]
+    assert times == sorted(times)
+    total_ms = (times[-1] - times[0]) * 1000
+    assert 0 < total_ms < 1000
+    text = format_chain("probe-1")
+    assert "ms total" in text and "Logged" in text
+    assert "probe-1" in sampled_ids()
+
+
+def test_commit_sampling_knob():
+    sim = Sim(seed=52)
+    sim.activate()
+    sim.knobs.CLIENT_COMMIT_SAMPLE = 1.0  # tag every commit
+    cluster = DynamicCluster(sim, ClusterConfig(), n_coordinators=1)
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def go():
+        for i in range(3):
+
+            async def put(tr, i=i):
+                tr.set(b"s%d" % i, b"v")
+
+            await db.run(put)
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    ids = [i for i in sampled_ids() if i.startswith("txn-")]
+    assert len(ids) >= 3
+    for did in ids[:3]:
+        stages = [e["Event"] for e in chain(did)]
+        assert "Replied" in stages, (did, stages)
+
+
+def test_status_machine_process_sections():
+    from foundationdb_tpu.client.management import get_status
+
+    sim = Sim(seed=53)
+    sim.activate()
+    cluster = DynamicCluster(sim, ClusterConfig(), n_coordinators=1)
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def go():
+        async def put(tr):
+            tr.set(b"x", b"1")
+
+        await db.run(put)
+        await delay(5.0)  # let SystemMonitor produce samples
+        doc = await get_status(cluster.coordinators, db.client)
+        assert doc.get("processes"), doc.keys()
+        for _addr, sm in doc["processes"].items():
+            assert "RunLoopLag" in sm and "Actors" in sm
+        assert doc.get("machines")
+        m = next(iter(doc["machines"].values()))
+        assert m["processes"] >= 1
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
