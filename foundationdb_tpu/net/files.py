@@ -93,8 +93,16 @@ class SimDisk:
 
 
 class SimFile:
-    SYNC_TIME = 0.0005  # modeled fsync
+    SYNC_TIME = 0.0005  # defaults; knobs SIM_FILE_SYNC_TIME/_WRITE_TIME
     WRITE_TIME = 0.00005
+
+    def _sync_time(self):
+        k = getattr(self.sim, "knobs", None)
+        return getattr(k, "SIM_FILE_SYNC_TIME", self.SYNC_TIME)
+
+    def _write_time(self):
+        k = getattr(self.sim, "knobs", None)
+        return getattr(k, "SIM_FILE_WRITE_TIME", self.WRITE_TIME)
 
     def __init__(self, sim, path: str, disk: "SimDisk" = None):
         self.sim = sim
@@ -115,7 +123,7 @@ class SimFile:
             self.disk._maybe_fault(grew)
 
     async def write(self, offset: int, data: bytes) -> None:
-        await delay(self.WRITE_TIME)
+        await delay(self._write_time())
         if self.disk is not None and self.disk.capacity is not None:
             # size() replays every pending op — only pay for it when a
             # disk-full window is actually armed
@@ -125,19 +133,19 @@ class SimFile:
         self._pending_ops.append(("write", offset, bytes(data)))
 
     async def read(self, offset: int, length: int) -> bytes:
-        await delay(self.WRITE_TIME)
+        await delay(self._write_time())
         self._fault()
         img = self._image()
         return bytes(img[offset : offset + length])
 
     async def sync(self) -> None:
-        await delay(self.SYNC_TIME)
+        await delay(self._sync_time())
         self._fault()
         self._durable = self._image()
         self._pending_ops = []
 
     async def truncate(self, size: int) -> None:
-        await delay(self.WRITE_TIME)
+        await delay(self._write_time())
         self._fault()
         self._pending_ops.append(("trunc", size))
 

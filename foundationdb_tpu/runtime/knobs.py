@@ -21,6 +21,12 @@ class Knobs:
     # the master's 4s gap-abandonment window so a merely-slow grant that
     # the master still honors isn't double-assigned.
     GETCOMMITVERSION_TIMEOUT = 6.0
+    # how long the master parks an out-of-order version request for its
+    # missing predecessor before abandoning the gap (a partition ate it)
+    MASTER_VERSION_GAP_TIMEOUT = 4.0
+    # consecutive master-unreachable batch failures before a proxy
+    # declares its master dead and retires
+    PROXY_MASTER_MISS_LIMIT = 8
     VERSIONS_PER_SECOND = 1_000_000
     MAX_READ_TRANSACTION_LIFE_VERSIONS = 5_000_000  # the MVCC window (~5s)
     MAX_VERSIONS_IN_FLIGHT = 100_000_000
@@ -29,6 +35,7 @@ class Knobs:
     CONFLICT_SET_CAPACITY = 1 << 14
     # storage
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
+    STORAGE_WAIT_VERSION_TIMEOUT = 1.0  # then future_version (client retries)
     STORAGE_FETCH_KEYS_BATCH = 10_000
     # TPU batched-read snapshot index on the storage read path
     # (SURVEY.md's secondary target): serves batch_get misses and
@@ -39,6 +46,7 @@ class Knobs:
     STORAGE_TPU_INDEX = None
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
+    TLOG_FSYNC_TIME = 0.0002  # modeled DiskQueue sync (SSD-class fsync)
     # multi-region log routing
     ROUTER_BUFFER_BYTES = 1 << 20  # per-tag unacked relay buffer cap
     # data distribution (DataDistributionTracker.actor.cpp knobs
@@ -57,6 +65,7 @@ class Knobs:
     RESOLUTION_BALANCE_RATIO = 1.5  # max/min load ratio that triggers a move
     RESOLUTION_SAMPLE_KEYS = 4096  # per-resolver load sample cap
     # ratekeeper (admission control by worst storage version lag)
+    RK_POLL_INTERVAL = 0.5  # proxy -> master getRate cadence
     RK_MAX_TPS = 100_000.0
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
@@ -72,6 +81,8 @@ class Knobs:
     SIM_FAST_LATENCY = 0.0008
     SIM_MAX_LATENCY = 0.003
     SIM_CLOG_MAX = 2.0
+    SIM_FILE_SYNC_TIME = 0.0005  # modeled fsync of a simulated file
+    SIM_FILE_WRITE_TIME = 0.00005
 
     def __init__(self, **overrides):
         for k, v in overrides.items():
@@ -142,3 +153,26 @@ class Knobs:
             self.RK_MAX_TPS = rng.random_choice([500.0, 10_000.0, 100_000.0])
         if rng.coinflip(0.25):
             self.GRV_BATCH_INTERVAL = rng.random_choice([0.0002, 0.0005, 0.002])
+        if rng.coinflip(0.25):
+            self.TLOG_FSYNC_TIME = rng.random_choice([0.00005, 0.0002, 0.002])
+        if rng.coinflip(0.25):
+            self.MASTER_VERSION_GAP_TIMEOUT = rng.random_choice([1.0, 4.0, 8.0])
+        if rng.coinflip(0.25):
+            self.PROXY_MASTER_MISS_LIMIT = rng.random_choice([3, 8, 20])
+        if rng.coinflip(0.25):
+            self.RK_POLL_INTERVAL = rng.random_choice([0.1, 0.5, 1.5])
+        if rng.coinflip(0.25):
+            self.STORAGE_WAIT_VERSION_TIMEOUT = rng.random_choice([0.3, 1.0, 3.0])
+        if rng.coinflip(0.25):
+            self.SIM_FILE_SYNC_TIME = rng.random_choice([0.0001, 0.0005, 0.005])
+        if rng.coinflip(0.25):
+            self.RESOLUTION_BALANCING_INTERVAL = rng.random_choice([0.3, 1.0, 5.0])
+        if rng.coinflip(0.25):
+            self.RESOLUTION_BALANCE_MIN_OPS = rng.random_choice([50, 200, 1000])
+        # coupled constraint: a proxy must keep waiting for a version
+        # grant at least as long as the master might legitimately park it
+        # behind a gap, or slow-but-honored grants get double-assigned
+        self.GETCOMMITVERSION_TIMEOUT = max(
+            self.GETCOMMITVERSION_TIMEOUT,
+            self.MASTER_VERSION_GAP_TIMEOUT + 2.0,
+        )

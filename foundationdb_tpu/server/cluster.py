@@ -81,7 +81,7 @@ class Cluster:
         assert cfg.n_storage % cfg.replication == 0, "storage must fill teams"
 
         # master
-        self.master = Master()
+        self.master = Master(knobs=self.knobs)
         p = sim.new_process("master")
         self.master.register(p)
 

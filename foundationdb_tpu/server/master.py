@@ -70,8 +70,9 @@ MAX_VERSION_JUMP = 10 * VERSIONS_PER_SECOND
 
 
 class Master:
-    def __init__(self, first_version: int = 0, uid: str = ""):
+    def __init__(self, first_version: int = 0, uid: str = "", knobs=None):
         self.uid = uid
+        self.knobs = knobs
         self.last_assigned = first_version
         self.last_assigned_at = 0.0
         self.live_committed = first_version
@@ -132,7 +133,12 @@ class Master:
                 gate: Future = Future()
                 key = (req.requesting_proxy, req.request_num)
                 self._parked[key] = gate
-                fired = await _timeout(gate, 4.0)
+                fired = await _timeout(
+                    gate,
+                    getattr(
+                        self.knobs, "MASTER_VERSION_GAP_TIMEOUT", 4.0
+                    ),
+                )
                 self._parked.pop(key, None)
                 if fired is None and self._req_seq.get(
                     req.requesting_proxy, 0
@@ -531,7 +537,9 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         resolver_ifaces.append(ResolverInterface(address=w.address, uid=r_uid))
 
     # RECOVERY_TXN: initialize version authority at the recovery version
-    master = Master(first_version=recovery_version, uid=uid)
+    master = Master(
+        first_version=recovery_version, uid=uid, knobs=process.sim.knobs
+    )
     master.register_instance(process)
     master_iface = MasterInterface(address=process.address, uid=uid)
 

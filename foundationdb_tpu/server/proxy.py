@@ -355,7 +355,7 @@ class Proxy:
         static test cluster) means no gating. A run of failed polls (dead
         master) disables gating and wakes parked GRVs — a throttled client
         must not hang across a recovery."""
-        interval = 0.5
+        interval = self.knobs.RK_POLL_INTERVAL
         misses = 0
         while True:
             await delay(interval)
@@ -545,7 +545,7 @@ class Proxy:
                     self._master_misses = 0
                 else:
                     self._master_misses += 1
-                if self._master_misses >= 8:
+                if self._master_misses >= self.knobs.PROXY_MASTER_MISS_LIMIT:
                     trace(
                         SevWarn,
                         "ProxyMasterGone",

@@ -46,7 +46,7 @@ from .systemdata import (
     decode_key_servers_value,
 )
 
-WAIT_FOR_VERSION_TIMEOUT = 1.0  # then future_version (client retries the read)
+WAIT_FOR_VERSION_TIMEOUT = 1.0  # default; knob STORAGE_WAIT_VERSION_TIMEOUT
 
 
 class StorageServer:
@@ -620,7 +620,7 @@ class StorageServer:
     async def _wait_for_version(self, version: Version):
         if version < self.data.oldest_version:
             raise TransactionTooOld()
-        deadline = delay(WAIT_FOR_VERSION_TIMEOUT)
+        deadline = delay(getattr(self.knobs, "STORAGE_WAIT_VERSION_TIMEOUT", WAIT_FOR_VERSION_TIMEOUT))
         while self.version.get() < version:
             which = await wait_for_any([self.version.on_change(), deadline])
             if which == 1:

@@ -208,7 +208,8 @@ class TLog:
                     self._mem_bytes += len(payload)
                 await self.dq.commit()
             else:
-                await delay(FSYNC_TIME)  # modeled DiskQueue push + fsync
+                # modeled DiskQueue push + fsync
+                await delay(getattr(self.knobs, "TLOG_FSYNC_TIME", FSYNC_TIME))
             durable._set(None)
         finally:
             # on cancellation (process kill) the version must not stay
