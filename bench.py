@@ -227,6 +227,155 @@ def bench_e2e():
     )
 
 
+_EVIDENCE_BEGIN = "<!-- degraded-evidence:begin -->"
+_EVIDENCE_END = "<!-- degraded-evidence:end -->"
+
+
+def bench_degraded_evidence():
+    """BENCH_COMPONENT=degraded_evidence (also auto-run by the default
+    bench when the TPU tunnel is unreachable): run the grid kernel on the
+    CPU JAX backend at the bench smoke shape and persist per-phase
+    op/byte counts (XLA cost analysis) plus a bandwidth-model device-time
+    prediction into BENCH_NOTES.md — so the numbers a wedged-tunnel round
+    would otherwise assert from memory are derived, on the record, and
+    reviewable against the next healthy-tunnel capture."""
+    import jax
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.conflict import grid as G
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+    batches_n = int(os.environ.get("BENCH_EVIDENCE_BATCHES", "40"))
+    txns_n = int(os.environ.get("BENCH_EVIDENCE_TXNS", "640"))
+    kw = int(os.environ.get("BENCH_KEY_WIDTH", "12"))
+    cap = 1 << 17
+    while cap < 4 * txns_n * WINDOW:
+        cap <<= 1
+    log(f"degraded evidence: CPU grid kernel at {batches_n}x{txns_n}, cap {cap}")
+    global BATCHES, TXNS
+    BATCHES, TXNS = batches_n, txns_n
+    batches = make_batches(batches_n, txns_n)
+    tpu = TpuConflictSet(key_width=kw, capacity=cap)
+    enc = [tpu.encode(txs) for txs in batches]
+    state = tpu._state
+    batch = enc[0][0]  # encode() returns (Batch, n_real, epoch)
+    B, S, lp1 = state.grid.shape
+
+    def costed(name, fn, *args):
+        try:
+            c = jax.jit(fn).lower(*args).compile().cost_analysis()
+            if isinstance(c, (list, tuple)):
+                c = c[0] if c else {}
+            return {
+                "phase": name,
+                "gflops": round(float(c.get("flops", 0.0)) / 1e9, 3),
+                "mbytes": round(
+                    float(c.get("bytes accessed", 0.0)) / 1e6, 2
+                ),
+            }
+        except Exception as e:  # cost analysis is best-effort per backend
+            log(f"cost analysis for {name} failed: {e!r}")
+            return {"phase": name, "gflops": None, "mbytes": None}
+
+    now = jnp.int32(WINDOW)
+    oldest = jnp.int32(0)
+    H = G.history_conflicts(state, batch)
+    commit = G.intra_batch_commits(batch, H)
+    phases = [
+        costed("history_conflicts", G.history_conflicts, state, batch),
+        costed("intra_batch_commits", G.intra_batch_commits, batch, H),
+        costed(
+            "merge_writes", G.merge_writes, state, batch, commit, now, oldest
+        ),
+        costed(
+            "resolve_batch (end-to-end)",
+            lambda st, b: G._resolve_one(st, b, now, oldest, oldest),
+            state,
+            batch,
+        ),
+    ]
+
+    # a short measured CPU run anchors the counts to an actual execution
+    work = [(enc[i], i + WINDOW, i) for i in range(min(GROUP, batches_n))]
+    tpu.detect_many_encoded(work)  # compile
+    tpu2 = TpuConflictSet(key_width=kw, capacity=cap)
+    work2 = [(tpu2.encode(txs), i + WINDOW, i) for i, txs in enumerate(
+        batches[: min(GROUP, batches_n)]
+    )]
+    t0 = time.time()
+    tpu2.detect_many_encoded(work2)
+    cpu_batch_ms = (time.time() - t0) * 1000 / len(work2)
+
+    # device-time prediction from the bandwidth model: the grid phases are
+    # HBM-bound dense passes (grid.py module doc), so bytes/bandwidth is
+    # the floor a healthy-tunnel capture should approach
+    HBM_GBS = float(os.environ.get("BENCH_HBM_GBS", "819"))  # v5e spec
+    total_mb = sum(p["mbytes"] or 0.0 for p in phases[:3])
+    pred_ms = total_mb / (HBM_GBS * 1e3) * 1e3  # MB / (GB/s)
+
+    lines = [
+        _EVIDENCE_BEGIN,
+        "## Degraded-evidence capture (CPU backend; tunnel unreachable)",
+        "",
+        f"Captured {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} on "
+        f"the CPU JAX backend (jax {jax.__version__}); shape "
+        f"{batches_n}x{txns_n} txns (the documented smoke shape), grid "
+        f"B={B} S={S} lanes={lp1 - 1}, key_width={kw}, capacity={cap}, "
+        f"GROUP={GROUP}, WINDOW={WINDOW}.",
+        "",
+        "Per-phase XLA cost analysis (one batch through the jitted phase):",
+        "",
+        "| phase | GFLOPs | MB accessed |",
+        "|---|---|---|",
+    ]
+    for p in phases:
+        lines.append(
+            f"| {p['phase']} | {p['gflops']} | {p['mbytes']} |"
+        )
+    lines += [
+        "",
+        f"Measured CPU execution: {cpu_batch_ms:.1f} ms/batch "
+        f"(group of {len(work2)} via resolve_many).",
+        f"Bandwidth-model device prediction: {total_mb:.1f} MB/batch over "
+        f"{HBM_GBS:.0f} GB/s HBM ≈ **{pred_ms:.2f} ms/batch** in-scan "
+        f"(compare scratch/profile_donate.py's ~4.6 ms at the full "
+        f"200x2500 shape; phases are HBM-bound, so scale with MB/batch).",
+        _EVIDENCE_END,
+    ]
+    section = "\n".join(lines) + "\n"
+    notes_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_NOTES.md")
+    try:
+        with open(notes_path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    if _EVIDENCE_BEGIN in text and _EVIDENCE_END in text:
+        pre = text.split(_EVIDENCE_BEGIN)[0]
+        post = text.split(_EVIDENCE_END, 1)[1].lstrip("\n")
+        text = pre + section + post
+    else:
+        text = text.rstrip("\n") + "\n\n" + section
+    with open(notes_path, "w") as f:
+        f.write(text)
+    log(f"degraded evidence appended to {notes_path}")
+    print(
+        json.dumps(
+            {
+                "metric": "degraded_evidence",
+                "value": round(pred_ms, 3),
+                "unit": "predicted_ms_per_batch",
+                "cpu_ms_per_batch": round(cpu_batch_ms, 1),
+                "phases": phases,
+            }
+        )
+    )
+
+
 def probe_device(max_tries=3):
     """Probe JAX backend init in a SUBPROCESS with a hard timeout: a hung
     TPU tunnel must not hang the bench (round-3 failure mode — the capture
@@ -273,6 +422,9 @@ def main():
         return
     if os.environ.get("BENCH_COMPONENT") == "e2e":
         bench_e2e()
+        return
+    if os.environ.get("BENCH_COMPONENT") == "degraded_evidence":
+        bench_degraded_evidence()
         return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 
@@ -335,6 +487,13 @@ def main():
     )
     if platform is None:
         log("no usable JAX backend after retries; native baseline stands")
+        # tunnel unreachable: leave derived per-phase evidence on record
+        # (CPU grid kernel + XLA cost analysis -> BENCH_NOTES.md) so the
+        # round's device-time expectations are reviewable, not asserted
+        try:
+            bench_degraded_evidence()
+        except Exception as e:
+            log(f"degraded-evidence capture failed: {e!r}")
         return
 
     try:

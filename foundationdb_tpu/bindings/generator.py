@@ -85,6 +85,30 @@ class StreamGenerator:
         self.emit("PUSH", self.data_prefix)
         self.emit("GET_RANGE_STARTS_WITH" + self._suffix())
 
+    def gen_get_key(self):
+        # anchors inside the data keyspace with small offsets: walks stay
+        # cheap, while edge offsets still escape the prefix (exercising
+        # the clamp-to-prefix-window spec behavior on both sides)
+        self.emit("PUSH", self.data_prefix)
+        self.emit("PUSH", self.rnd.randrange(-3, 5))  # OFFSET
+        self.emit("PUSH", self.rnd.choice([0, 1]))  # OR_EQUAL
+        self.emit("PUSH", self.key())
+        self.emit("GET_KEY" + self._suffix())
+
+    def gen_get_range_selector(self):
+        a, b = sorted([self.key(), self.key()])
+        self.emit("PUSH", self.data_prefix)
+        self.emit("PUSH", self.rnd.choice([0, 1]))  # STREAMING_MODE (ignored)
+        self.emit("PUSH", self.rnd.choice([0, 1]))  # REVERSE
+        self.emit("PUSH", self.rnd.choice([0, 4, 12]))  # LIMIT (0 = all)
+        self.emit("PUSH", self.rnd.randrange(-2, 4))  # END_OFFSET
+        self.emit("PUSH", self.rnd.choice([0, 1]))  # END_OR_EQUAL
+        self.emit("PUSH", b)
+        self.emit("PUSH", self.rnd.randrange(-2, 4))  # BEGIN_OFFSET
+        self.emit("PUSH", self.rnd.choice([0, 1]))  # BEGIN_OR_EQUAL
+        self.emit("PUSH", a)
+        self.emit("GET_RANGE_SELECTOR" + self._suffix())
+
     def gen_atomic(self):
         suffix = self.rnd.choices(["", "_DATABASE"], (6, 1))[0]
         op = self.rnd.choice(ATOMIC_NAMES)
@@ -168,6 +192,8 @@ class StreamGenerator:
         (gen_clear_range, 4),
         (gen_get_range, 8),
         (gen_get_range_starts_with, 3),
+        (gen_get_key, 6),
+        (gen_get_range_selector, 5),
         (gen_atomic, 10),
         (gen_conflict_range, 3),
         (gen_conflict_key, 2),

@@ -15,9 +15,12 @@ Reference provenance: semantics from fdbclient/ReadYourWrites.actor.cpp
 
 from __future__ import annotations
 
+import bisect
+
 from ..errors import FdbError, NotCommitted, TransactionTooOld
 from ..kv.atomic import apply_atomic
 from ..kv.mutations import MutationType
+from ..kv.selector import SELECTOR_END, KeySelector, as_selector
 
 
 class ModelDatabase:
@@ -149,14 +152,67 @@ class ModelTransaction:
             v = apply_atomic(op, v, p)
         return v
 
+    def _visible_keys(self) -> list[bytes]:
+        """Sorted keys present through this txn's overlay, excluding the
+        system keyspace — the key list selector walks navigate."""
+        keys = set(self._snapshot)
+        for op, k, _p in self._ops:
+            if op != "clear_range":
+                keys.add(k)
+        return sorted(
+            k
+            for k in keys
+            if k < SELECTOR_END and self._visible(k) is not None
+        )
+
+    async def get_key(self, selector, snapshot: bool = False) -> bytes:
+        """Reference-exact selector resolution over the overlay-visible
+        key list, with the same conflict span and read-version pin timing
+        as the real client (transaction.py get_key) — conformance diffs
+        the two instruction-for-instruction."""
+        k, off = as_selector(selector).normalized()
+        await self.get_read_version()
+        keys = self._visible_keys()
+        i = bisect.bisect_left(keys, k) - 1 + off
+        if i < 0:
+            resolved = b""
+        elif i >= len(keys):
+            resolved = SELECTOR_END
+        else:
+            resolved = keys[i]
+        if off >= 1:
+            lo = k
+            hi = _key_after(resolved) if resolved < SELECTOR_END else SELECTOR_END
+        else:
+            lo, hi = resolved, min(k, SELECTOR_END)
+        if lo < hi and not snapshot:
+            self._rcr.append((lo, hi))
+        return resolved
+
     async def get_range(
         self,
-        begin: bytes,
-        end: bytes,
+        begin,
+        end,
         limit: int = 1 << 30,
         reverse: bool = False,
         snapshot: bool = False,
     ):
+        if isinstance(begin, KeySelector) or isinstance(end, KeySelector):
+            b = (
+                begin
+                if not isinstance(begin, KeySelector)
+                else await self.get_key(begin, snapshot=True)
+            )
+            e = (
+                end
+                if not isinstance(end, KeySelector)
+                else await self.get_key(end, snapshot=True)
+            )
+            if b >= e:
+                return []
+            return await self.get_range(
+                b, e, limit=limit, reverse=reverse, snapshot=snapshot
+            )
         await self.get_read_version()
         keys = set(self._snapshot)
         for op, k, _p in self._ops:

@@ -8,11 +8,17 @@ maintains a data stack (items tagged with their instruction number), a
 global named-transaction map, and a last-seen version; errors surface as
 packed ("ERROR", code) tuples on the stack.
 
+Key-selector ops are implemented per the spec: GET_KEY resolves a
+selector and clamps the result to the caller's prefix window;
+GET_RANGE_SELECTOR reads between two selectors and filters to the
+prefix; GET_RANGE_STARTS_WITH routes through selector endpoints
+(firstGreaterOrEqual of the prefix and of strinc(prefix)), exercising
+the same resolution machinery.
+
 Deviations from the spec, all down to client-surface gaps or scope:
-key-selector ops (GET_KEY, GET_RANGE_SELECTOR) and START_THREAD /
-WAIT_EMPTY are not implemented (the client has no key selectors or
-multi-thread tester harness); STREAMING_MODE parameters are accepted and
-ignored (reads return full results).
+START_THREAD / WAIT_EMPTY are not implemented (no multi-thread tester
+harness); STREAMING_MODE parameters are accepted and ignored (reads
+return full results).
 
 The same machine runs against the real client Database AND the
 ModelDatabase oracle (bindings/model.py) — diffing the two stacks and
@@ -23,6 +29,7 @@ from __future__ import annotations
 
 from ..errors import FdbError
 from ..kv.mutations import MutationType
+from ..kv.selector import KeySelector
 from ..layers import tuple as T
 from ..net.sim import BrokenPromise
 from ..client.transaction import strinc as _strinc
@@ -210,12 +217,71 @@ class StackMachine:
             inum, begin, end, limit, reverse, snapshot, database
         )
 
+    async def op_GET_KEY(self, inum, ins, snapshot=False, database=False):
+        """Spec: pop KEY, OR_EQUAL, OFFSET, PREFIX; resolve the selector;
+        push the result clamped to the prefix window (a result below the
+        prefix pushes the prefix, one above pushes strinc(prefix)) —
+        which also makes streams deterministic when resolution walks out
+        of the tester's keyspace."""
+        key, or_equal, offset, prefix = self.pop(4)
+        sel = KeySelector(key, bool(or_equal), int(offset))
+        if database:
+            async def body(tr):
+                return await tr.get_key(sel)
+
+            result = await self.db.run(body)
+        else:
+            result = await self._tr().get_key(sel, snapshot=snapshot)
+        if result.startswith(prefix):
+            self.push(inum, result)
+        elif result < prefix:
+            self.push(inum, prefix)
+        else:
+            self.push(inum, _strinc(prefix))
+
+    async def op_GET_RANGE_SELECTOR(
+        self, inum, ins, snapshot=False, database=False
+    ):
+        """Spec: pop BEGIN_KEY, BEGIN_OR_EQUAL, BEGIN_OFFSET, END_KEY,
+        END_OR_EQUAL, END_OFFSET, LIMIT, REVERSE, STREAMING_MODE, PREFIX;
+        range-read between the selectors, filter rows to the prefix, push
+        the packed flat tuple."""
+        bk, boe, boff, ek, eoe, eoff, limit, reverse, _mode, prefix = self.pop(10)
+        begin = KeySelector(bk, bool(boe), int(boff))
+        end = KeySelector(ek, bool(eoe), int(eoff))
+        limit = limit or (1 << 29)
+        if database:
+            async def body(tr):
+                return await tr.get_range(
+                    begin, end, limit=limit, reverse=bool(reverse)
+                )
+
+            rows = await self.db.run(body)
+        else:
+            rows = await self._tr().get_range(
+                begin, end, limit=limit, reverse=bool(reverse),
+                snapshot=snapshot,
+            )
+        flat = []
+        for k, v in rows:
+            if k.startswith(prefix):
+                flat.extend([k, v])
+        self.push(inum, T.pack(tuple(flat)))
+
     async def op_GET_RANGE_STARTS_WITH(
         self, inum, ins, snapshot=False, database=False
     ):
+        # routed through selector endpoints (the spec's equivalence:
+        # [fGoE(prefix), fGoE(strinc(prefix))) is exactly the prefix range)
         prefix, limit, reverse, _mode = self.pop(4)
         await self._push_range(
-            inum, prefix, _strinc(prefix), limit, reverse, snapshot, database
+            inum,
+            KeySelector.first_greater_or_equal(prefix),
+            KeySelector.first_greater_or_equal(_strinc(prefix)),
+            limit,
+            reverse,
+            snapshot,
+            database,
         )
 
     async def _push_range(

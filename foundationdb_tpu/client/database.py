@@ -188,8 +188,12 @@ class Database:
         self._locations.insert(reply.begin, reply.end, reply.team)
         return reply.begin, reply.end, reply.team
 
-    def invalidate_cache(self, key: bytes) -> None:
-        b, e, _ = self._locations.range_for(key)
+    def invalidate_cache(self, key: bytes, before: bool = False) -> None:
+        b, e, _ = (
+            self._locations.range_before(key)
+            if before
+            else self._locations.range_for(key)
+        )
         self._locations.insert(b, e, None)
 
     # -- watches ---------------------------------------------------------------

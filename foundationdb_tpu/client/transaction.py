@@ -32,18 +32,22 @@ from ..errors import (
 from ..kv.atomic import apply_atomic
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
+from ..kv.selector import SELECTOR_END, KeySelector, as_selector
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay
 from .loadbalance import load_balanced_request
 from ..runtime.buggify import buggify
 from ..server.interfaces import (
     CommitRequest,
+    GetKeyRequest,
     GetKeyValuesRequest,
     GetReadVersionRequest,
     GetValueRequest,
     Tokens,
     TransactionData,
 )
+
+MAX_FIND_KEY_HOPS = 10000  # findKey shard hops (a loop here is a bug)
 
 MAX_READ_ATTEMPTS = 60
 FUTURE_VERSION_RETRY_DELAY = 0.05
@@ -197,14 +201,111 @@ class Transaction:
         self._writes[key] = ("value_db", v)
         return v
 
+    async def get_key(self, selector, snapshot: bool = False) -> bytes:
+        """Resolve a key selector (kv/selector.py) to an existing key at
+        the read version, seen through the RYW overlay — this txn's
+        uncommitted sets add keys to the walk and its clears remove them
+        (ReadYourWrites getKey over the WriteMap). A bare key coerces to
+        firstGreaterOrEqual. Resolution clamps to b"" / b"\\xff" at the
+        keyspace edges; a non-snapshot read conflict-protects the span the
+        walk observed (anchor through resolved key), which is exactly what
+        makes selector navigation serializable."""
+        k, off = as_selector(selector).normalized()
+        # resolution always observes the database (even when the walk ends
+        # at a keyspace edge): pin the read version up front so pin timing
+        # matches the model oracle instruction-for-instruction
+        await self.get_read_version()
+        if self._writes or any(v for _b, _e, v in self._cleared.ranges()):
+            resolved = await self._selector_resolve_merged(k, off)
+        else:
+            # no overlay: the storage getKey walk (findKey) resolves it
+            resolved = await self._find_key(k, off)
+        if off >= 1:
+            lo = k
+            hi = key_after(resolved) if resolved < SELECTOR_END else SELECTOR_END
+        else:
+            lo, hi = resolved, min(k, SELECTOR_END)
+        if lo < hi:
+            for body in self._unreadable:
+                if lo <= body < hi:
+                    # a pending versionstamped key may land inside the
+                    # observed span; the walk's outcome is unknowable
+                    raise AccessedUnreadable()
+            if not snapshot:
+                self._rcr.append((lo, hi))
+        return resolved
+
+    async def _selector_resolve_merged(self, k: bytes, off: int) -> bytes:
+        """Overlay-aware resolution: walk the MERGED view (storage rows at
+        the read version + this txn's writes) — the RYWIterator path."""
+        if off >= 1:
+            if k >= SELECTOR_END:
+                return SELECTOR_END
+            rows = await self._get_range_merged(k, SELECTOR_END, off, False)
+            return rows[off - 1][0] if len(rows) >= off else SELECTOR_END
+        needed = 1 - off
+        hi = min(k, SELECTOR_END)
+        if hi <= b"":
+            return b""
+        rows = await self._get_range_merged(b"", hi, needed, True)
+        return rows[-1][0] if len(rows) >= needed else b""
+
+    async def _find_key(self, k: bytes, off: int) -> bytes:
+        """The findKey loop (NativeAPI.actor.cpp:1220): ask the shard the
+        anchor locates to; a partially-resolved reply repositions the
+        selector at the shard boundary and the loop follows it to the
+        adjacent shard."""
+        version = await self.get_read_version()
+        for _hop in range(MAX_FIND_KEY_HOPS):
+            if off >= 1:
+                if k >= SELECTOR_END:
+                    return SELECTOR_END
+                before = False
+                s_begin, s_end, _team = await self.db._locate(k)
+            else:
+                if k <= b"":
+                    return b""
+                before = True
+                s_begin, s_end, _team = await self.db._locate_before(k)
+            req = GetKeyRequest(
+                key=k, offset=off, version=version, begin=s_begin, end=s_end
+            )
+            reply = await self._load_balanced(
+                k, Tokens.GET_KEY, req, before=before
+            )
+            if reply.resolved:
+                return reply.key
+            k, off = reply.key, reply.offset
+        raise AssertionError("findKey did not converge (shard-walk loop)")
+
     async def get_range(
         self,
-        begin: bytes,
-        end: bytes,
+        begin,
+        end,
         limit: int = 1 << 30,
         reverse: bool = False,
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
+        if isinstance(begin, KeySelector) or isinstance(end, KeySelector):
+            # selector endpoints resolve first (snapshot resolution — the
+            # range read below conflict-protects the resolved range), then
+            # the byte-range path runs unchanged; bare-byte endpoints stay
+            # raw bounds, NOT selectors
+            b = (
+                begin
+                if not isinstance(begin, KeySelector)
+                else await self.get_key(begin, snapshot=True)
+            )
+            e = (
+                end
+                if not isinstance(end, KeySelector)
+                else await self.get_key(end, snapshot=True)
+            )
+            if b >= e:
+                return []
+            return await self.get_range(
+                b, e, limit=limit, reverse=reverse, snapshot=snapshot
+            )
         assert not reverse or limit < (1 << 30), "reverse needs a limit"
         for body in self._unreadable:
             if begin <= body < end:
@@ -314,18 +415,23 @@ class Transaction:
             return reply.data, chunk_lo
         return reply.data, None
 
-    async def _load_balanced(self, key: bytes, token: str, req):
+    async def _load_balanced(self, key: bytes, token: str, req, before=False):
         """Replica selection with retry — LoadBalance.actor.h:158.
         Per-replica latency/penalty model + hedged second request
         (client/loadbalance.py); wrong_shard_server or a dead team
         refreshes the location cache — NativeAPI's handling in
-        getValue/getRange."""
+        getValue/getRange. ``before`` targets the shard holding the keys
+        immediately BELOW ``key`` (backward selector walks / reverse
+        scans — NativeAPI's isBackward location lookups)."""
         version_retries = 0
         last_err: Exception = None
         if buggify():
-            self.db.invalidate_cache(key)  # stale-location path every read
+            self.db.invalidate_cache(key, before=before)  # stale-location path
         for attempt in range(MAX_READ_ATTEMPTS):
-            _b, _e, team = await self.db._locate(key)
+            if before:
+                _b, _e, team = await self.db._locate_before(key)
+            else:
+                _b, _e, team = await self.db._locate(key)
             try:
                 return await load_balanced_request(self.db, team, token, req)
             except FutureVersion as e:
@@ -338,7 +444,7 @@ class Transaction:
                 # whole team unreachable or moved: drop cache, back off,
                 # re-locate
                 last_err = e
-                self.db.invalidate_cache(key)
+                self.db.invalidate_cache(key, before=before)
                 await delay(0.1)
         raise last_err or BrokenPromise("read retries exhausted")
 

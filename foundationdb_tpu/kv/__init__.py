@@ -6,8 +6,11 @@ The analog of the reference's common types layer (fdbclient/):
 - versioned_map.py— multi-version ordered map, the storage server's in-memory
                     MVCC window (fdbclient/VersionedMap.h:31-68)
 - keyrange_map.py — key-range → value map (fdbclient/KeyRangeMap.h:36)
+- selector.py     — key selectors, offset-relative keyspace navigation
+                    (fdbclient/FDBTypes.h:462 KeySelectorRef)
 """
 
 from .mutations import Mutation, MutationType  # noqa: F401
 from .versioned_map import VersionedMap  # noqa: F401
 from .keyrange_map import KeyRangeMap  # noqa: F401
+from .selector import SELECTOR_END, KeySelector, as_selector  # noqa: F401

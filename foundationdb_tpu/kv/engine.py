@@ -146,9 +146,16 @@ class KeyValueStoreMemory:
     def read_value(self, key: bytes):
         return self._map.get(key)
 
-    def read_range(self, begin: bytes, end: bytes, limit: int = 1 << 30):
+    def read_range(
+        self, begin: bytes, end: bytes, limit: int = 1 << 30, reverse: bool = False
+    ):
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
+        if reverse:
+            # the LAST `limit` rows below `end` — O(limit), so a
+            # reverse-limited storage read never materializes the shard
+            ks = self._keys[max(lo, hi - limit) : hi]
+            return [(k, self._map[k]) for k in reversed(ks)]
         out = []
         for k in self._keys[lo:hi]:
             out.append((k, self._map[k]))

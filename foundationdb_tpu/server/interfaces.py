@@ -223,6 +223,36 @@ class WatchValueReply:
 
 
 @dataclass
+class GetKeyRequest:
+    """Resolve a NORMALIZED key selector against this server's shard
+    (getKeyQ, storageserver.actor.cpp:1288). ``key``/``offset`` are the
+    or_equal-removed form (kv/selector.py): the result is the key
+    ``offset`` positions after "the last key < key". ``begin``/``end``
+    are the client's located shard bounds (end=None = infinity): servers
+    that own everything tag-route their data client-side here, so the
+    walk must clamp to the bounds the CLIENT located, intersected with
+    the server's own shard map."""
+
+    key: bytes = b""
+    offset: int = 1
+    version: Version = INVALID_VERSION
+    begin: bytes = b""
+    end: Optional[bytes] = None
+
+
+@dataclass
+class GetKeyReply:
+    """resolved=True: ``key`` is the answer (clamped to [b"", b"\\xff"]).
+    resolved=False: the walk ran off this shard's edge — continue with the
+    normalized selector (``key``, ``offset``) at the adjacent shard (the
+    client findKey loop, NativeAPI.actor.cpp:1220)."""
+
+    key: bytes = b""
+    offset: int = 0
+    resolved: bool = True
+
+
+@dataclass
 class GetKeyValuesRequest:
     begin: bytes = b""
     end: bytes = b""
@@ -310,6 +340,7 @@ class StorageInterface:
         token = {
             "getValue": Tokens.GET_VALUE,
             "getKeyValues": Tokens.GET_KEY_VALUES,
+            "getKey": Tokens.GET_KEY,
         }.get(method)
         if token is not None:
             return Endpoint(self.address, token)
@@ -422,6 +453,7 @@ class Tokens:
     # storage
     GET_VALUE = "storage.getValue"
     GET_KEY_VALUES = "storage.getKeyValues"
+    GET_KEY = "storage.getKey"
     GET_SHARD_STATE = "storage.getShardState"
     GET_SHARD_METRICS = "storage.getShardMetrics"
     GET_SPLIT_KEY = "storage.getSplitKey"

@@ -28,7 +28,10 @@ from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, trace
+from ..kv.selector import SELECTOR_END
 from .interfaces import (
+    GetKeyReply,
+    GetKeyRequest,
     GetKeyValuesReply,
     GetKeyValuesRequest,
     GetValueReply,
@@ -665,6 +668,65 @@ class StorageServer:
         self._c_bytes_q.add(sum(len(k) + len(v) for k, v in data[:limit]))
         return GetKeyValuesReply(data=data[:limit], more=more)
 
+    def _owned_span(self, key: bytes, version: Version, before: bool = False):
+        """(begin, end) of the owned-and-ready shard containing ``key`` (or
+        the keys immediately below it, for backward walks); raises
+        wrong_shard_server when this server can't serve it at ``version``."""
+        if self.own_all:
+            return b"", None
+        b, e, state = (
+            self.owned.range_before(key) if before else self.owned.range_for(key)
+        )
+        if state is None or state[0] != "owned" or version < state[1]:
+            raise WrongShardServer()
+        return b, e
+
+    async def get_key(self, req: GetKeyRequest) -> GetKeyReply:
+        """Resolve a normalized key selector within this shard (getKeyQ,
+        storageserver.actor.cpp:1288): walk ``offset`` keys forward from
+        the anchor (or ``1 - offset`` backward), clamped to the shard —
+        a walk that runs off the shard edge returns a partially-resolved
+        selector repositioned at the boundary with the remaining offset,
+        which the client's findKey loop carries to the adjacent shard.
+        System keys (>= \\xff) are invisible: past-end resolves to \\xff,
+        before-begin to b"" (the reference's non-system clamps)."""
+        if buggify():
+            await delay(0.001)  # slow replica (hedging/load-balance paths)
+        await self._wait_for_version(req.version)
+        k, off = req.key, req.offset
+        self._c_queries.add()
+        before = off < 1
+        o_begin, o_end = self._owned_span(k, req.version, before=before)
+        # clamp to the CLIENT's located shard: a tag-routed server (static
+        # clusters: own_all=True, shard map client-side) holds only its
+        # shards' rows, so walking past the located bounds would misread
+        # its local gap as the global keyspace edge
+        s_begin = max(o_begin, req.begin)
+        if o_end is None:
+            s_end = req.end
+        elif req.end is None:
+            s_end = o_end
+        else:
+            s_end = min(o_end, req.end)
+        if off >= 1:
+            hi = SELECTOR_END if s_end is None else min(s_end, SELECTOR_END)
+            rows = self._read_range_merged(k, max(k, hi), req.version, off, False)
+            if len(rows) >= off:
+                return GetKeyReply(key=rows[off - 1][0], resolved=True)
+            if s_end is None or s_end >= SELECTOR_END:
+                return GetKeyReply(key=SELECTOR_END, resolved=True)
+            return GetKeyReply(key=s_end, offset=off - len(rows), resolved=False)
+        needed = 1 - off
+        hi = min(k, SELECTOR_END) if s_end is None else min(k, s_end, SELECTOR_END)
+        rows = self._read_range_merged(
+            s_begin, max(s_begin, hi), req.version, needed, True
+        )
+        if len(rows) >= needed:
+            return GetKeyReply(key=rows[-1][0], resolved=True)
+        if s_begin == b"":
+            return GetKeyReply(key=b"", resolved=True)
+        return GetKeyReply(key=s_begin, offset=off + len(rows), resolved=False)
+
     def _read_range_merged(self, begin, end, version, limit, reverse):
         """Window-over-engine merge (the reference's readRange:916 merge of
         the in-memory versioned tree with the durable engine)."""
@@ -674,6 +736,8 @@ class StorageServer:
             )
         win = self.data.entries_with_tombstones(begin, end, version)
         overlay = dict(win)
+        if reverse:
+            return self._merged_reverse(begin, end, overlay, limit)
         want = limit + len(win) + 1
         while True:
             base = self._engine_range(begin, end, want)
@@ -687,15 +751,41 @@ class StorageServer:
                     merged.pop(k, None)
                 else:
                     merged[k] = v
-            rows = sorted(merged.items(), reverse=reverse)
+            rows = sorted(merged.items())
             exhausted = len(base) < want
-            if reverse and not exhausted:
-                # forward-limited engine read can't bound a reverse scan;
-                # fall back to the full range (rare path)
-                want = 1 << 30
-                continue
             if len(rows) >= limit or exhausted:
                 return rows[:limit]
+            want *= 2
+
+    def _merged_reverse(self, begin, end, overlay, limit):
+        """Bounded chunked backward walk: each chunk reads the engine's
+        LAST ``want`` rows below ``hi`` (O(want), kv/engine.py reverse
+        read); inside [chunk_lo, hi) the engine rows are complete, so the
+        overlay merge is exact there. Tombstone-heavy windows shrink a
+        chunk's yield and the next chunk doubles — engine rows touched
+        stay proportional to the limit, never the shard (the old path
+        re-read the whole range through ``want = 1 << 30`` whenever the
+        first chunk didn't cover it)."""
+        out: list = []
+        hi = end
+        want = limit + len(overlay) + 1
+        while True:
+            base = self.engine.read_range(begin, hi, limit=want, reverse=True)
+            exhausted = len(base) < want
+            chunk_lo = begin if exhausted else base[-1][0]
+            merged = {
+                k: v for k, v in base if not k.startswith(PRIVATE_PREFIX)
+            }
+            for k, v in overlay.items():
+                if chunk_lo <= k < hi:
+                    if v is None:
+                        merged.pop(k, None)
+                    else:
+                        merged[k] = v
+            out.extend(sorted(merged.items(), reverse=True))
+            if len(out) >= limit or exhausted:
+                return out[:limit]
+            hi = chunk_lo
             want *= 2
 
     def _index_enabled(self) -> bool:
@@ -872,6 +962,7 @@ class StorageServer:
         self.process = process
         process.register(Tokens.GET_VALUE, self.get_value)
         process.register(Tokens.GET_KEY_VALUES, self.get_key_values)
+        process.register(Tokens.GET_KEY, self.get_key)
         process.register(f"storage.version#{self.uid}", self._get_version)
         process.register(f"storage.ping#{self.uid}", self._ping)
         process.register(f"storage.metrics#{self.uid}", self._metrics)

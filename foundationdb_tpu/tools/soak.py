@@ -52,6 +52,7 @@ from ..workloads import (
     RandomMoveKeysWorkload,
     RollbackWorkload,
     RywFuzzWorkload,
+    SelectorFuzzWorkload,
     SerializabilityWorkload,
     SidebandWorkload,
     WatchesWorkload,
@@ -101,6 +102,9 @@ def run_one(seed: int, verbose: bool = False) -> dict:
         # the API-fuzz battery (oracle-checked) rotates in per seed
         ApiCorrectnessWorkload(db, rng.fork(), transactions=15, client_id=0),
         RywFuzzWorkload(db, rng.fork(), transactions=8, client_id=0),
+        # key-selector navigation (getKey walks + RYW overlay resolution)
+        # runs every seed: cross-shard continuation is shape-dependent
+        SelectorFuzzWorkload(db, rng.fork(), transactions=6, client_id=0),
     ]
     if shape_rng.coinflip(0.5):
         workloads += [

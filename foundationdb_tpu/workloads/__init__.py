@@ -51,6 +51,7 @@ from .consistency_check import ConsistencyCheckWorkload  # noqa: E402,F401
 from .api_correctness import ApiCorrectnessWorkload  # noqa: E402,F401
 from .serializability import SerializabilityWorkload  # noqa: E402,F401
 from .ryw_fuzz import RywFuzzWorkload  # noqa: E402,F401
+from .selector_fuzz import SelectorFuzzWorkload  # noqa: E402,F401
 from .atomic_ops import AtomicOpsWorkload  # noqa: E402,F401
 from .watches import WatchesWorkload  # noqa: E402,F401
 from .backup_workload import BackupWorkload  # noqa: E402,F401

@@ -47,7 +47,10 @@ def run_real(seed, n_ops, chaos=False, **cfg):
 
 
 def run_model(stream):
-    """The oracle side: same machine, model database."""
+    """The oracle side: same machine, model database. The instruction
+    rows are stored in the model too (as the spec stores them in the real
+    database): selector walks navigate the WHOLE keyspace, so both sides
+    must hold identical key sets for resolution parity."""
     from foundationdb_tpu.net.sim import Sim
 
     sim = Sim(seed=0)  # an event loop for the async surface
@@ -55,6 +58,7 @@ def run_model(stream):
     db = ModelDatabase()
 
     async def go():
+        await store_instructions(db, INS_PREFIX, stream)
         machine = StackMachine(db, INS_PREFIX)
         await machine.run_stream(stream)
         data = sorted(
@@ -140,6 +144,89 @@ def test_error_tuples_surface_conflicts():
     assert [v for _k, v in log_real] == [v for _k, v in log_model]
     # the last logged item is the conflict error tuple
     assert T.unpack(T.unpack(log_real[-1][1])[0]) == (b"ERROR", b"1020")
+
+
+def test_selector_ops_in_generated_streams():
+    """The generator actually emits the selector ops (the conformance
+    seeds above only prove what the streams contain)."""
+    ops = set()
+    for seed in range(10):
+        gen = StreamGenerator(seed, data_prefix=DATA_PREFIX)
+        for ins in gen.generate(1000):
+            op = ins[0]
+            ops.add(op.removesuffix("_SNAPSHOT").removesuffix("_DATABASE"))
+    assert {"GET_KEY", "GET_RANGE_SELECTOR", "GET_RANGE_STARTS_WITH"} <= ops
+
+
+def test_directed_selector_stream():
+    """A hand-written stream of GET_KEY / GET_RANGE_SELECTOR edge cases —
+    or_equal variants, negative offsets, walks off both keyspace ends
+    (prefix-window clamps), inverted selector ranges — must match the
+    model oracle item for item."""
+    k = lambda i: DATA_PREFIX + b"%03d" % i  # noqa: E731
+    stream = [("NEW_TRANSACTION",)]
+    for i in (2, 5, 9):
+        stream += [("PUSH", b"v%d" % i), ("PUSH", k(i)), ("SET",)]
+    stream += [("COMMIT",), ("NEW_TRANSACTION",)]
+    # every constructor shape around existing, missing, and edge keys
+    for anchor in (k(0), k(2), k(4), k(5), k(9), k(10)):
+        for or_equal in (0, 1):
+            for offset in (-3, -1, 0, 1, 2, 30):
+                stream += [
+                    ("PUSH", DATA_PREFIX),
+                    ("PUSH", offset),
+                    ("PUSH", or_equal),
+                    ("PUSH", anchor),
+                    ("GET_KEY",),
+                ]
+    # selector ranges: forward, reverse+limit, inverted (empty)
+    for b_off, e_off, limit, reverse in (
+        (0, 1, 0, 0), (1, 3, 2, 0), (-2, 2, 0, 1), (2, -2, 0, 0)
+    ):
+        stream += [
+            ("PUSH", DATA_PREFIX),
+            ("PUSH", 0),  # STREAMING_MODE
+            ("PUSH", reverse),
+            ("PUSH", limit),
+            ("PUSH", e_off),
+            ("PUSH", 1),
+            ("PUSH", k(9)),
+            ("PUSH", b_off),
+            ("PUSH", 0),
+            ("PUSH", k(2)),
+            ("GET_RANGE_SELECTOR",),
+        ]
+    # starts-with routes through selector endpoints
+    stream += [
+        ("PUSH", 0),
+        ("PUSH", 0),
+        ("PUSH", 0),
+        ("PUSH", DATA_PREFIX),
+        ("GET_RANGE_STARTS_WITH",),
+        ("COMMIT",),
+        ("PUSH", RESULT_PREFIX),
+        ("LOG_STACK",),
+    ]
+
+    sim = Sim(seed=23)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(n_storage=4, replication=2))
+    db = Database(sim, cluster.proxy_addrs)
+
+    async def go():
+        await store_instructions(db, INS_PREFIX, stream)
+        machine = StackMachine(db, INS_PREFIX)
+        await machine.run_stream(stream)
+
+        async def read_log(tr):
+            return await tr.get_range(RESULT_PREFIX, RESULT_PREFIX + b"\xff")
+
+        return await db.run(read_log)
+
+    log_real = sim.run_until_done(spawn(go()), 600.0)
+    _data_model, log_model = run_model(stream)
+    assert [v for _k, v in log_real] == [v for _k, v in log_model]
+    assert len(log_real) > 70  # every GET_KEY/GET_RANGE pushed something
 
 
 @pytest.mark.parametrize("seed", [3, 17, 29, 41])
