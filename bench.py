@@ -371,7 +371,9 @@ def bench_degraded_evidence():
                 "unit": "predicted_ms_per_batch",
                 "cpu_ms_per_batch": round(cpu_batch_ms, 1),
                 "phases": phases,
-            }
+                "kernel": tpu2.metrics.snapshot(),
+            },
+            default=str,
         )
     )
 
@@ -579,7 +581,12 @@ def _device_phase(batches, nat_tps, nat_verdicts):
                 "value": round(tpu_tps, 1),
                 "unit": "txn/s",
                 "vs_baseline": round(tpu_tps / nat_tps, 3),
-            }
+                # kernel counter snapshot: occupancy / overflow replays /
+                # transfer bytes ride every capture, so a number whose run
+                # hit reshard churn carries that provenance on its face
+                "kernel": tpu.metrics.snapshot(),
+            },
+            default=str,
         )
     )
 

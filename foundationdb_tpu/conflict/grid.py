@@ -835,6 +835,26 @@ def make_state(n_buckets: int, n_slots: int, lanes: int) -> GridState:
     )
 
 
+def occupancy_stats(state: GridState) -> dict:
+    """Bucket-occupancy / headroom gauges for the kernel's
+    CounterCollection (status document + bench provenance). Host numpy
+    over the small per-bucket arrays only — the [B, S, L+1] grid itself
+    never crosses the tunnel."""
+    count = np.asarray(state.count)
+    B, S, _ = state.grid.shape
+    live = int(count.sum())
+    worst = int(count.max(initial=0))
+    return {
+        "liveRows": live,
+        "usedBuckets": int((count > 0).sum()),
+        "bucketCount": int(B),
+        "slotCapacity": int(S),
+        "maxBucketRows": worst,
+        "slotHeadroom": int(S - worst),
+        "fillFraction": round(live / float(B * S), 6),
+    }
+
+
 def codes_to_bytes(codes: np.ndarray) -> np.ndarray:
     """uint32[N, L] lane codes → void-dtype byte keys whose memcmp order
     equals lane order (big-endian), for vectorized searchsorted."""

@@ -29,6 +29,7 @@ cluster's code path.
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
@@ -39,10 +40,12 @@ from .api import CommitTransaction, ConflictSet, Verdict
 from .tpu_backend import (
     _INT32_REBASE_THRESHOLD,
     _VERDICT_TABLE,
+    KernelMetrics,
     KeyReservoir,
     _bucket,
     _pick_pivots,
     encode_transactions,
+    tree_nbytes,
 )
 
 def _lex_gt(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -102,6 +105,14 @@ class MeshConflictSet(ConflictSet):
         # batch flooding one gap with brand-new keys needs pivots from
         # the sample — same escalation as the single-device backend)
         self._sample = KeyReservoir()
+        # kernel observability — same collection shape as TpuConflictSet,
+        # with per-partition occupancy
+        self.metrics = KernelMetrics()
+        self.metrics.gauge(
+            "occupancy", lambda: sharded.stacked_occupancy_stats(self._states)
+        )
+        self.metrics.gauge("stagingSlots", lambda: G.staging_slots(self._S))
+        self.metrics.gauge("inflightGroups", lambda: len(self._inflight))
 
     def _fresh_states(self):
         return self._jax.device_put(
@@ -135,9 +146,11 @@ class MeshConflictSet(ConflictSet):
         self._maybe_rebase(now)
 
     def encode(self, transactions):
+        t0 = time.perf_counter()
         b = encode_transactions(
             transactions, self._width, self._base, sample_cb=self._sample.add
         )
+        self.metrics.encode_s.add(time.perf_counter() - t0)
         return b, len(transactions), self._base_epoch
 
     def detect_many_encoded(self, work):
@@ -165,6 +178,9 @@ class MeshConflictSet(ConflictSet):
             )
             self.oldest_version = horizon
             items.append(item)
+        self.metrics.groups.add()
+        self.metrics.batches.add(len(items))
+        self.metrics.txns.add(sum(n for _b, n, _now, _op, _opost in items))
         group = {"items": items, "done": None}
         self._dispatch(group)
         self._inflight.append(group)
@@ -177,12 +193,18 @@ class MeshConflictSet(ConflictSet):
     # -- internals ------------------------------------------------------------
 
     def _dispatch(self, group) -> None:
+        t0 = time.perf_counter()
+        self.metrics.dispatches.add()
         group["snapshot"] = self._jax.tree_util.tree_map(
             lambda x: x + 0, self._states
         )
         outs = []
         st = self._states
         for batch, _n, now, old_pre, old_post in group["items"]:
+            self.metrics.note_shape(
+                (batch.rb.shape[0], batch.rb.shape[1], batch.wb.shape[1])
+            )
+            self.metrics.h2d_bytes.add(tree_nbytes(batch))
             st, verdicts, pressure = self._step(st, batch, now, old_pre, old_post)
             outs.append((verdicts, pressure))
             # start device→host copies now — _collect's device_get then
@@ -194,6 +216,7 @@ class MeshConflictSet(ConflictSet):
                     copy_async()
         self._states = st
         group["outs"] = outs
+        self.metrics.dispatch_s.add(time.perf_counter() - t0)
 
     def _collect(self, group):
         if group["done"] is not None:
@@ -201,13 +224,21 @@ class MeshConflictSet(ConflictSet):
         while self._inflight and self._inflight[0] is not group:
             self._collect(self._inflight[0])
         assert self._inflight and self._inflight[0] is group
+        t0 = time.perf_counter()
         S2 = G.staging_slots(self._S)
         for attempt in range(6):
             pressures = self._jax.device_get([p for _v, p in group["outs"]])
+            self.metrics.d2h_bytes.add(sum(int(p.nbytes) for p in pressures))
             worst = np.max(np.stack(pressures), axis=0)  # [n_parts, 2]
             over = (worst[:, 0] > S2) | (worst[:, 1] > self._S)
             if not over.any():
                 break
+            self.metrics.overflow_replays.add()
+            self.metrics.replayed_groups.add(len(self._inflight))
+            # abandoned-chain barrier (see TpuConflictSet._collect): the
+            # replay must not reuse memory a still-executing donated
+            # computation writes into
+            self._jax.block_until_ready(self._states)
             # overflow: rebalance the offending partitions from the
             # pre-group snapshot, then replay this group and everything
             # after it (verdicts are deterministic — invisible to callers).
@@ -224,6 +255,7 @@ class MeshConflictSet(ConflictSet):
                     self._states, pr = sharded.reshard_partition(
                         self._states, int(p), self._B, self._S
                     )
+                    self.metrics.reshards_device.add()
                     if pr <= self._S:
                         continue
                 self._host_reshard_partition(int(p))
@@ -239,7 +271,9 @@ class MeshConflictSet(ConflictSet):
             group["outs"], group["items"]
         ):
             out = np.asarray(self._jax.device_get(verdicts))
+            self.metrics.d2h_bytes.add(int(out.nbytes))
             done.append([table[v] for v in out[:n_real].tolist()])
+        self.metrics.collect_s.add(time.perf_counter() - t0)
         group["done"] = done
         group.pop("snapshot", None)
         group.pop("outs", None)
@@ -252,6 +286,8 @@ class MeshConflictSet(ConflictSet):
         boundaries ∪ the key sample clipped to its range (the mesh analog
         of TpuConflictSet._reshard_host_sampled). Grows every partition
         when a balanced split cannot fit."""
+        t0 = time.perf_counter()
+        self.metrics.reshards_host.add()
         tm = self._jax.tree_util.tree_map
         while True:
             shard = tm(lambda x: x[p], self._states)
@@ -282,12 +318,14 @@ class MeshConflictSet(ConflictSet):
             self._states = tm(
                 lambda full, s: full.at[p].set(s), self._states, new_shard
             )
+            self.metrics.reshard_s.add(time.perf_counter() - t0)
             return
 
     def _grow(self) -> None:
         """Double every partition's bucket count (vmapped on-device
         reshard folds floors and rebalances each shard)."""
         self._B *= 2
+        self.metrics.capacity_growths.add()
         grown, _pr = self._jax.vmap(
             functools.partial(
                 G.reshard_device.__wrapped__,
@@ -316,3 +354,4 @@ class MeshConflictSet(ConflictSet):
             )
             self._base = new_base
             self._base_epoch += 1
+            self.metrics.rebases.add()

@@ -182,6 +182,28 @@ def build_sharded_resolver(mesh: Mesh, lanes: int):
     return jax.jit(shard_fn, donate_argnums=(0,))
 
 
+def stacked_occupancy_stats(states: G.GridState) -> dict:
+    """Per-partition occupancy gauges over a stacked (mesh) state — the
+    multi-device face of grid.occupancy_stats. Aggregates host-side from
+    the small count arrays; the grids stay on their devices."""
+    counts = np.asarray(states.count)  # [n_parts, B]
+    n_parts, B = counts.shape
+    S = states.grid.shape[2]
+    per_part = counts.sum(axis=1)
+    worst = int(counts.max(initial=0))
+    return {
+        "partitions": int(n_parts),
+        "liveRows": int(per_part.sum()),
+        "liveRowsPerPartition": [int(x) for x in per_part],
+        "usedBuckets": int((counts > 0).sum()),
+        "bucketCount": int(n_parts * B),
+        "slotCapacity": int(S),
+        "maxBucketRows": worst,
+        "slotHeadroom": int(S - worst),
+        "fillFraction": round(float(per_part.sum()) / float(n_parts * B * S), 6),
+    }
+
+
 def reshard_partition(
     states: G.GridState, p: int, n_buckets: int, n_slots: int
 ) -> tuple[G.GridState, int]:

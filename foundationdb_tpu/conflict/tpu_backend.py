@@ -20,16 +20,76 @@ kernels must have a sim-mode CPU twin).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import numpy as np
 
 from . import grid as G
 from . import keys as K
+from ..runtime.stats import CounterCollection
 from .api import CommitTransaction, ConflictSet, Verdict
 
 _INT32_REBASE_THRESHOLD = 1 << 30
 _SAMPLE_CAP = 131072
 _VERDICT_TABLE = [Verdict(i) for i in range(3)]
+
+
+class KernelMetrics:
+    """The conflict kernel's CounterCollection (shared by the single-device
+    and mesh backends) — per-phase wall-time latency samples, overflow-
+    replay / reshard / growth counters, host↔device transfer bytes, and a
+    jit-cache hit/miss tally (a new stacked shape = a new XLA program).
+    Wall time is real time (``time.perf_counter``), NOT sim time: these
+    phases measure actual device/tunnel work, which virtual time cannot
+    see. Surfaced through ``resolver.metrics`` / the status document's
+    resolver sections, and embedded into bench captures."""
+
+    def __init__(self, ident: str = ""):
+        self.collection = CounterCollection("ConflictKernel", ident)
+        c = self.collection.counter
+        self.groups = c("groups")
+        self.batches = c("batches")
+        self.txns = c("txns")
+        self.dispatches = c("deviceDispatches")
+        self.overflow_replays = c("overflowReplays")
+        self.replayed_groups = c("replayedGroups")
+        self.reshards_device = c("reshardsDevice")
+        self.reshards_host = c("reshardsHost")
+        self.capacity_growths = c("capacityGrowths")
+        self.rebases = c("rebases")
+        self.h2d_bytes = c("hostToDeviceBytes")
+        self.d2h_bytes = c("deviceToHostBytes")
+        self.jit_hits = c("jitCacheHits")
+        self.jit_misses = c("jitCacheMisses")
+        self.encode_s = self.collection.latency("encodeSeconds")
+        self.dispatch_s = self.collection.latency("dispatchSeconds")
+        self.collect_s = self.collection.latency("collectSeconds")
+        self.reshard_s = self.collection.latency("reshardSeconds")
+        self._shapes: set = set()
+
+    def note_shape(self, key) -> None:
+        """Host-side jit-cache model: a (G, T, KR, KW) stacked shape seen
+        before hits the compile cache; a fresh one forces a compile."""
+        if key in self._shapes:
+            self.jit_hits.add()
+        else:
+            self._shapes.add(key)
+            self.jit_misses.add()
+
+    def gauge(self, name: str, fn) -> None:
+        self.collection.gauge(name, fn)
+
+    def snapshot(self) -> dict:
+        return self.collection.snapshot()
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of every array leaf (host↔device transfer accounting)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
 
 
 def _bucket(n: int, floor: int = 1) -> int:
@@ -165,6 +225,15 @@ class TpuConflictSet(ConflictSet):
         self._rebalance_wanted = False
         # dispatched-but-uncollected groups, in dispatch order
         self._inflight: list[dict] = []
+        # kernel observability (ISSUE 5): counters/samples every perf PR
+        # cites instead of tunnel-dependent bench reruns
+        self.metrics = KernelMetrics()
+        self._last_pressure = (0, 0)  # (max staged, max kept) at last collect
+        self.metrics.gauge("occupancy", lambda: G.occupancy_stats(self._state))
+        self.metrics.gauge("stagingSlots", lambda: G.staging_slots(self._S))
+        self.metrics.gauge("lastMaxStagedRows", lambda: self._last_pressure[0])
+        self.metrics.gauge("lastMaxKeptRows", lambda: self._last_pressure[1])
+        self.metrics.gauge("inflightGroups", lambda: len(self._inflight))
 
     # -- ConflictSet interface ------------------------------------------------
 
@@ -206,7 +275,9 @@ class TpuConflictSet(ConflictSet):
     def encode(self, transactions: list[CommitTransaction]):
         """Pre-encode a batch for detect_many_encoded. Encodings are
         base-relative: a version rebase invalidates them (epoch stamp)."""
+        t0 = time.perf_counter()
         b = self._encode(transactions)
+        self.metrics.encode_s.add(time.perf_counter() - t0)
         return b, len(transactions), self._base_epoch
 
     def detect_many_encoded(self, work) -> list[list[Verdict]]:
@@ -237,6 +308,10 @@ class TpuConflictSet(ConflictSet):
             counts.append(n_real)
             batches.append(b)
 
+        self.metrics.groups.add()
+        self.metrics.batches.add(len(batches))
+        self.metrics.txns.add(sum(counts))
+
         if not self._resharded_once:
             self._reshard(self._state)
         elif self._rebalance_wanted:
@@ -264,7 +339,13 @@ class TpuConflictSet(ConflictSet):
         return result
 
     def _dispatch(self, group) -> None:
+        t0 = time.perf_counter()
         metas = group["metas"]
+        st = group["stacked"]
+        self.metrics.dispatches.add()
+        self.metrics.note_shape(
+            (len(metas), st.rb.shape[-3], st.rb.shape[-2], st.wb.shape[-2])
+        )
         nows = np.asarray([m[0] - self._base for m in metas], np.int32)
         olds_pre = np.asarray(
             [max(m[1] - self._base, 0) for m in metas], np.int32
@@ -272,10 +353,18 @@ class TpuConflictSet(ConflictSet):
         olds_post = np.asarray(
             [max(m[2] - self._base, 0) for m in metas], np.int32
         )
-        # copy before dispatch: resolve_many donates the state buffers
-        group["snapshot"] = jax.tree_util.tree_map(lambda x: x + 0, self._state)
+        # resolve_many DONATES its state argument, so never hand it a
+        # buffer that anything else still reads: the pre-group snapshot
+        # keeps the ORIGINAL arrays (never donated → always intact for a
+        # replay) and the kernel consumes a fresh `+ 0` copy whose only
+        # reference is this dispatch. The earlier form (snapshot = copy,
+        # donate the original) raced: with warm compiles the copy executes
+        # genuinely async, and XLA:CPU would recycle the donated buffer
+        # under the still-pending read — garbage pivots on replay.
+        group["snapshot"] = self._state
+        work = jax.tree_util.tree_map(lambda x: x + 0, self._state)
         state, verdicts, pressure = G.resolve_many(
-            self._state, group["stacked"], nows, olds_pre, olds_post
+            work, group["stacked"], nows, olds_pre, olds_post
         )
         self._state = state
         group["verdicts"] = verdicts
@@ -288,6 +377,7 @@ class TpuConflictSet(ConflictSet):
             copy_async = getattr(a, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
+        self.metrics.dispatch_s.add(time.perf_counter() - t0)
 
     def _collect(self, group) -> list[list[Verdict]]:
         if group["done"] is not None:
@@ -297,12 +387,24 @@ class TpuConflictSet(ConflictSet):
         while self._inflight and self._inflight[0] is not group:
             self._collect(self._inflight[0])
         assert self._inflight and self._inflight[0] is group
+        t0 = time.perf_counter()
         S2 = G.staging_slots(self._S)
         for attempt in range(6):
             # one host↔device round trip for both pressure and verdicts
             pr, out = jax.device_get((group["pressure"], group["verdicts"]))
+            self.metrics.d2h_bytes.add(int(pr.nbytes) + int(out.nbytes))
             if int(pr[0]) <= S2 and int(pr[1]) <= self._S:
                 break
+            self.metrics.overflow_replays.add()
+            self.metrics.replayed_groups.add(len(self._inflight))
+            # the in-flight chain is being ABANDONED for a replay: wait for
+            # its async computations to finish first. An abandoned
+            # resolve_many still writes into its donated buffers, and the
+            # allocator can hand that freed memory to the replay's
+            # snapshot/reshard arrays while the write is in flight —
+            # observed as garbage pivot codes whenever compiles are cache-
+            # warm enough for execution to run genuinely async.
+            jax.block_until_ready(self._state)
             # overflow: some bucket needed more staging/grid slots than it
             # has — rebuild the grid under fresh pivots from the pre-group
             # snapshot, then replay this group and everything after it.
@@ -318,6 +420,8 @@ class TpuConflictSet(ConflictSet):
                 self._dispatch(g)
         else:
             raise RuntimeError("conflict grid reshard did not converge")
+        self._last_pressure = (int(pr[0]), int(pr[1]))
+        self.metrics.collect_s.add(time.perf_counter() - t0)
         if int(pr[1]) > self._S - max(4, self._S // 4) or int(pr[0]) > S2 - max(
             2, S2 // 4
         ):
@@ -388,6 +492,7 @@ class TpuConflictSet(ConflictSet):
         # overlaps earlier groups' device compute instead of stalling the
         # dispatch inside the jit call (a ~46 ms/group synchronous upload
         # over the tunnel otherwise)
+        self.metrics.h2d_bytes.add(tree_nbytes(stacked))
         return jax.tree_util.tree_map(jax.device_put, stacked)
 
     def _reshard(
@@ -403,17 +508,24 @@ class TpuConflictSet(ConflictSet):
         seen (an append workload writing past the last boundary), so
         overflow-replay escalation and the initial reshard use the host
         path, whose pivots also come from the recent key sample."""
+        t0 = time.perf_counter()
         if self._resharded_once and not with_sample:
             if grow:
                 self._B *= 2
+                self.metrics.capacity_growths.add()
             while True:
                 state, pressure = G.reshard_device(from_state, self._B, self._S)
                 if int(jax.device_get(pressure)) <= self._S:
                     self._state = state
+                    self.metrics.reshards_device.add()
+                    self.metrics.reshard_s.add(time.perf_counter() - t0)
                     return
                 # quantile split can't fit: more buckets and retry
                 self._B *= 2
+                self.metrics.capacity_growths.add()
         self._reshard_host_sampled(from_state, grow=grow)
+        self.metrics.reshards_host.add()
+        self.metrics.reshard_s.add(time.perf_counter() - t0)
 
     def _reshard_host_sampled(
         self, from_state: G.GridState, grow: bool = False
@@ -422,6 +534,7 @@ class TpuConflictSet(ConflictSet):
         (covers keys arriving in not-yet-merged batches)."""
         if grow:
             self._B *= 2
+            self.metrics.capacity_growths.add()
         state = from_state
         L = self._lanes
         codes, _vers = G.live_rows(state)
@@ -447,6 +560,7 @@ class TpuConflictSet(ConflictSet):
                 # quantile split still left some bucket over capacity:
                 # grow and retry with more pivots available
                 self._B *= 2
+                self.metrics.capacity_growths.add()
         self._resharded_once = True
 
     def _maybe_rebase(self, now: int) -> None:
@@ -459,3 +573,4 @@ class TpuConflictSet(ConflictSet):
             self._state = G.rebase(self._state, np.int32(delta))
             self._base = new_base
             self._base_epoch += 1
+            self.metrics.rebases.add()

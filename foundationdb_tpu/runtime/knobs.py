@@ -69,6 +69,11 @@ class Knobs:
     RK_MAX_TPS = 100_000.0
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
+    # observability
+    TRACE_ROLL_BYTES = 10 << 20  # roll the JSONL trace file here (reference: 10 MB)
+    TRACE_ROLL_KEEP = 10  # rolled files kept (path.1 .. path.N)
+    LATENCY_PROBE_INTERVAL = 1.0  # CC's timed GRV/read/commit probe cadence
+    METRICS_TRACE_INTERVAL = 5.0  # per-role CounterCollection trace cadence
     # client
     # fraction of commits auto-tagged with a transaction-debug id
     # (g_traceBatch sampling; tr.set_debug_id forces one)
@@ -169,6 +174,10 @@ class Knobs:
             self.RESOLUTION_BALANCING_INTERVAL = rng.random_choice([0.3, 1.0, 5.0])
         if rng.coinflip(0.25):
             self.RESOLUTION_BALANCE_MIN_OPS = rng.random_choice([50, 200, 1000])
+        if rng.coinflip(0.25):
+            self.LATENCY_PROBE_INTERVAL = rng.random_choice([0.5, 1.0, 5.0])
+        if rng.coinflip(0.25):
+            self.METRICS_TRACE_INTERVAL = rng.random_choice([1.0, 5.0, 10.0])
         # coupled constraint: a proxy must keep waiting for a version
         # grant at least as long as the master might legitimately park it
         # behind a gap, or slow-but-honored grants get double-assigned

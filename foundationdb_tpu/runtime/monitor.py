@@ -13,6 +13,31 @@ from .loop import current_loop, now
 from .trace import SevInfo, trace
 
 
+def memory_kb() -> tuple[int, int]:
+    """(current RSS KB, peak RSS KB). ``ru_maxrss`` is the lifetime
+    HIGH-WATER mark, not the current footprint — reporting it as MemoryKB
+    made a post-spike process look permanently bloated. Current RSS comes
+    from /proc/self/statm when available (Linux); elsewhere both report
+    the rusage peak."""
+    peak = 0
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        pass
+    cur = peak
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])  # resident field
+        import os
+
+        cur = pages * os.sysconf("SC_PAGE_SIZE") // 1024
+    except Exception:
+        pass
+    return cur, peak
+
+
 async def system_monitor(process, interval: float = 5.0):
     from .futures import delay
 
@@ -22,12 +47,7 @@ async def system_monitor(process, interval: float = 5.0):
         before = now()
         await delay(interval)
         lag = max(0.0, (now() - before) - interval)
-        try:
-            import resource
-
-            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        except Exception:
-            rss_kb = 0
+        rss_kb, peak_kb = memory_kb()
         coll = getattr(process, "actors", None)
         n_actors = len(getattr(coll, "_actors", []) or [])
         sample = dict(
@@ -37,6 +57,7 @@ async def system_monitor(process, interval: float = 5.0):
             Endpoints=len(getattr(process, "endpoints", {}) or {}),
             QueueDepth=len(getattr(loop, "_queue", []) or []),
             MemoryKB=rss_kb,
+            PeakMemoryKB=peak_kb,
         )
         # latest sample stays readable on demand (the status document's
         # machine/process sections pull it through worker.systemMetrics)

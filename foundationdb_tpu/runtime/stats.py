@@ -52,9 +52,15 @@ class Counter:
 
 class LatencySample:
     """Reservoir sample of durations (seconds). Bounded memory; exact
-    percentiles while under capacity, uniform reservoir beyond it."""
+    percentiles while under capacity, uniform reservoir beyond it.
 
-    __slots__ = ("name", "cap", "count", "_buf", "_rnd")
+    The reservoir is sorted lazily, at most once per run of reads: every
+    percentile/snapshot call reuses one cached sorted buffer until the
+    next ``add`` dirties it (a snapshot used to re-sort three times —
+    once per percentile — which made a busy status pull O(3·n log n) per
+    sample)."""
+
+    __slots__ = ("name", "cap", "count", "_buf", "_rnd", "_sorted")
 
     def __init__(self, name: str, cap: int = 1024, seed: int = 0):
         self.name = name
@@ -62,9 +68,11 @@ class LatencySample:
         self.count = 0
         self._buf: list[float] = []
         self._rnd = random.Random(seed)
+        self._sorted: list[float] = None  # cache; None = dirty
 
     def add(self, dt: float) -> None:
         self.count += 1
+        self._sorted = None
         if len(self._buf) < self.cap:
             self._buf.append(dt)
         else:
@@ -72,18 +80,30 @@ class LatencySample:
             if i < self.cap:
                 self._buf[i] = dt
 
+    def _sorted_buf(self) -> list[float]:
+        if self._sorted is None:
+            self._sorted = sorted(self._buf)
+        return self._sorted
+
     def percentile(self, p: float) -> float:
         if not self._buf:
             return 0.0
-        s = sorted(self._buf)
+        s = self._sorted_buf()
         return s[min(int(len(s) * p), len(s) - 1)]
 
     def snapshot(self) -> dict:
+        # one sort serves all three percentiles
+        s = self._sorted_buf()
+        n = len(s)
+
+        def pick(p: float) -> float:
+            return s[min(int(n * p), n - 1)] if n else 0.0
+
         return {
             "count": self.count,
-            "p50": round(self.percentile(0.5), 6),
-            "p95": round(self.percentile(0.95), 6),
-            "p99": round(self.percentile(0.99), 6),
+            "p50": round(pick(0.5), 6),
+            "p95": round(pick(0.95), 6),
+            "p99": round(pick(0.99), 6),
         }
 
 

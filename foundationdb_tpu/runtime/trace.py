@@ -2,26 +2,46 @@
 
 Events are dicts with severity, type, time, and process identity, collected
 per run (and optionally mirrored to a JSONL file, the counterpart of the
-reference's rolling XML trace logs). A SevError event marks the run failed —
-exactly the simulator's pass/fail criterion (SURVEY.md §3.4).
+reference's rolling XML trace logs — like the reference, the file rolls at
+a size threshold, keeping a bounded set of numbered predecessors). A
+SevError event marks the run failed — exactly the simulator's pass/fail
+criterion (SURVEY.md §3.4).
 """
 
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 SevDebug, SevInfo, SevWarn, SevWarnAlways, SevError = 5, 10, 20, 30, 40
 
 _SEV_NAMES = {5: "Debug", 10: "Info", 20: "Warn", 30: "WarnAlways", 40: "Error"}
 
+# the reference rolls trace files at 10 MB (TraceLog's maxLogsSize /
+# rollsize, flow/Trace.cpp) and prunes old ones; same defaults here —
+# overridable per-log (tools pass Knobs.TRACE_ROLL_BYTES / _KEEP)
+DEFAULT_ROLL_BYTES = 10 << 20
+DEFAULT_ROLL_KEEP = 10
+
 
 class TraceLog:
-    def __init__(self, path: Optional[str] = None, min_severity: int = SevInfo):
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        min_severity: int = SevInfo,
+        max_file_bytes: int = DEFAULT_ROLL_BYTES,
+        keep_files: int = DEFAULT_ROLL_KEEP,
+    ):
         self.events: list[dict] = []
         self.error_count = 0
         self.min_severity = min_severity
+        self.path = path
+        self.max_file_bytes = max_file_bytes
+        self.keep_files = max(1, keep_files)
+        self.rolls = 0
         self._file = open(path, "a") if path else None
+        self._file_bytes = os.path.getsize(path) if path else 0
 
     def log(self, severity: int, event_type: str, time: float, process: str, **fields):
         if severity < self.min_severity:
@@ -37,8 +57,41 @@ class TraceLog:
         if severity >= SevError:
             self.error_count += 1
         if self._file:
-            self._file.write(json.dumps(ev, default=str) + "\n")
+            line = json.dumps(ev, default=str) + "\n"
+            self._file.write(line)
             self._file.flush()
+            self._file_bytes += len(line)
+            if self.max_file_bytes and self._file_bytes >= self.max_file_bytes:
+                self._roll()
+
+    def _roll(self) -> None:
+        """Rotate path → path.1 → … → path.N (oldest deleted), then reopen
+        a fresh file. The live handle closes promptly so rolled files
+        never pin descriptors."""
+        self._file.close()
+        self._file = None
+        oldest = f"{self.path}.{self.keep_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._file = open(self.path, "a")
+        self._file_bytes = 0
+        self.rolls += 1
+
+    def rolled_paths(self) -> list[str]:
+        """Existing rolled siblings, oldest first (for trace consumers)."""
+        if not self.path:
+            return []
+        out = []
+        for i in range(self.keep_files, 0, -1):
+            p = f"{self.path}.{i}"
+            if os.path.exists(p):
+                out.append(p)
+        return out
 
     def of_type(self, event_type: str) -> list[dict]:
         return [e for e in self.events if e["Type"] == event_type]

@@ -18,9 +18,14 @@ from ..runtime.futures import AsyncVar, delay, timeout
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.loop import now
+from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
     ClientDBInfo,
+    CommitRequest,
+    GetKeyServersRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
     GetWorkersReply,
     GetWorkersRequest,
     OpenDatabaseRequest,
@@ -29,6 +34,7 @@ from .interfaces import (
     ServerDBInfo,
     SetDBInfoRequest,
     Tokens,
+    TransactionData,
     WorkerDetails,
 )
 
@@ -49,6 +55,17 @@ class ClusterController:
         # so a master dying MID-failover-recovery doesn't lose the intent
         self._failover_to: str = None
         self._failover_master_uid: str = None  # recruited with the override
+        # latency probes (Status.actor.cpp's latencyProbe: timed GRV, read,
+        # and commit transactions against the live cluster, feeding the
+        # status document's `latency_probe` section)
+        self.probe_stats = CounterCollection("LatencyProbe", process.address)
+        self._l_probe_grv = self.probe_stats.latency("grv")
+        self._l_probe_read = self.probe_stats.latency("read")
+        self._l_probe_commit = self.probe_stats.latency("commit")
+        self._c_probe_ok = self.probe_stats.counter("probesCompleted")
+        self._c_probe_err = self.probe_stats.counter("probeErrors")
+        self._probe_latest: dict = {}
+        self._probe_n = 0
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -64,6 +81,14 @@ class ClusterController:
         p.register(Tokens.CC_FORCE_FAILOVER, self.force_failover)
         self._actors.append(p.spawn(self.cluster_watch_database()))
         self._actors.append(p.spawn(self._broadcast_loop()))
+        self._actors.append(p.spawn(self._latency_probe_loop()))
+        self._actors.append(
+            p.spawn(
+                self.probe_stats.trace_loop(
+                    self.knobs.METRICS_TRACE_INTERVAL, p.address
+                )
+            )
+        )
 
     def shutdown(self) -> None:
         for t in (
@@ -277,6 +302,111 @@ class ClusterController:
         trace(SevInfo, "ForcedRecovery", self.process.address, Master=uid)
         return True
 
+    # -- latency probes (Status.actor.cpp latencyProbe) --------------------------
+
+    async def _latency_probe_loop(self):
+        """Timed GRV / read / commit probes against the live cluster,
+        round-robined over the current proxy set. Each leg is bounded;
+        failures count (a stalled cluster shows up as probe_errors rising
+        while the *_seconds numbers go stale) and never wedge the loop."""
+        from ..kv.mutations import Mutation, MutationType
+
+        probe_key = b"\xff\x02/status/probe/" + self.process.address.encode()
+        while True:
+            await delay(self.knobs.LATENCY_PROBE_INTERVAL)
+            info = self.db_info.get()
+            proxies = (
+                list(info.client_info.proxies)
+                if info is not None and info.client_info is not None
+                else []
+            )
+            if not proxies:
+                continue
+            proxy = proxies[self._probe_n % len(proxies)]
+            self._probe_n += 1
+            budget = max(self.knobs.LATENCY_PROBE_INTERVAL, 1.0)
+            latest = {}
+            try:
+                # GRV probe (the reference's transaction_start_seconds)
+                t0 = now()
+                grv = await timeout(
+                    self.process.request(proxy.ep("grv"), GetReadVersionRequest()),
+                    budget,
+                )
+                if grv is None:
+                    raise TimeoutError("grv probe timed out")
+                latest["grv_seconds"] = round(now() - t0, 6)
+                self._l_probe_grv.add(now() - t0)
+                version = grv.version
+
+                # read probe: locate the probe key's team, read at the GRV
+                # version (a missing key exercises the same path)
+                t0 = now()
+                loc = await timeout(
+                    self.process.request(
+                        proxy.ep("keyServers"),
+                        GetKeyServersRequest(key=probe_key),
+                    ),
+                    budget,
+                )
+                if loc is None or not loc.team:
+                    raise TimeoutError("key-location probe timed out")
+                val = await timeout(
+                    self.process.request(
+                        Endpoint(loc.team[0], Tokens.GET_VALUE),
+                        GetValueRequest(key=probe_key, version=version),
+                    ),
+                    budget,
+                )
+                if val is None:
+                    raise TimeoutError("read probe timed out")
+                latest["read_seconds"] = round(now() - t0, 6)
+                self._l_probe_read.add(now() - t0)
+
+                # commit probe: a blind write (no read conflict ranges →
+                # never conflicts) of the probe key in the \xff\x02
+                # keyspace — system-prefixed but NOT metadata, so it rides
+                # the normal commit path end to end
+                t0 = now()
+                rep = await timeout(
+                    self.process.request(
+                        proxy.ep("commit"),
+                        CommitRequest(
+                            transaction=TransactionData(
+                                read_snapshot=version,
+                                read_conflict_ranges=[],
+                                write_conflict_ranges=[
+                                    (probe_key, probe_key + b"\x00")
+                                ],
+                                mutations=[
+                                    Mutation(
+                                        MutationType.SET_VALUE,
+                                        probe_key,
+                                        b"%d" % version,
+                                    )
+                                ],
+                            )
+                        ),
+                    ),
+                    budget,
+                )
+                if rep is None:
+                    raise TimeoutError("commit probe timed out")
+                latest["commit_seconds"] = round(now() - t0, 6)
+                self._l_probe_commit.add(now() - t0)
+            except Exception as e:
+                self._c_probe_err.add()
+                trace(
+                    SevWarn,
+                    "LatencyProbeFailed",
+                    self.process.address,
+                    Err=repr(e),
+                )
+                continue
+            self._c_probe_ok.add()
+            latest["at"] = round(now(), 3)
+            self._probe_latest = latest
+
     async def get_status(self, _req) -> dict:
         """The cluster status document (Status.actor.cpp's aggregation):
         topology from the registry, per-role metrics pulled from every
@@ -362,31 +492,81 @@ class ClusterController:
             )
         doc["machines"] = machines
 
-        # aggregate sections (Status.actor.cpp's qos/data summaries).
+        # aggregate sections (Status.actor.cpp's qos/data summaries and the
+        # workload section's started/committed/conflicted tps + ops/sec).
         # Gauges may snapshot as None on a transient error — treat as 0.
+        def agg(kind: str, key: str) -> float:
+            total = 0
+            for w in workers.values():
+                for snap in (w.get("metrics") or {}).values():
+                    if snap.get("kind") == kind:
+                        total += snap.get(key) or 0
+            return total
+
         committed, durable = [], []
-        ops, txn_out, conflicts = 0, 0, 0
-        for w in workers.values():
-            for snap in (w.get("metrics") or {}).values():
+        resolvers = {}
+        for addr, w in workers.items():
+            for uid, snap in (w.get("metrics") or {}).items():
                 kind = snap.get("kind")
                 if kind == "storage":
                     committed.append(snap.get("version") or 0)
                     durable.append(snap.get("durableVersion") or 0)
-                    ops += snap.get("finishedQueries") or 0
-                elif kind == "proxy":
-                    txn_out += snap.get("txnCommitOut") or 0
-                    conflicts += snap.get("txnConflicts") or 0
+                elif kind == "resolver":
+                    # per-resolver section incl. the TPU kernel counters
+                    # (occupancy / overflow replays / transfer bytes)
+                    resolvers[uid] = dict(snap, address=addr)
+        doc["resolvers"] = resolvers
         if committed:
             doc["data"] = {
                 "max_storage_version": max(committed),
                 "min_durable_version": min(durable),
                 "storage_version_spread": max(committed) - min(committed),
             }
+
+        def tx(key: str) -> dict:
+            return {
+                "counter": agg("proxy", key),
+                "hz": round(agg("proxy", key + "_hz"), 2),
+            }
+
+        def sq(key: str) -> dict:
+            return {
+                "counter": agg("storage", key),
+                "hz": round(agg("storage", key + "_hz"), 2),
+            }
+
+        doc["workload"] = {
+            "transactions": {
+                "started": tx("txnStartIn"),
+                "committed": tx("txnCommitOut"),
+                "conflicted": tx("txnConflicts"),
+                "too_old": tx("txnTooOld"),
+                "commit_batches": tx("commitBatchesOut"),
+            },
+            "operations": {
+                "reads": sq("finishedQueries"),
+                "rows_read": sq("rowsQueried"),
+                "bytes_read": sq("bytesQueried"),
+                "writes": tx("mutations"),
+                "bytes_written": tx("mutationBytes"),
+            },
+        }
+        txn_out = agg("proxy", "txnCommitOut")
+        conflicts = agg("proxy", "txnConflicts")
+        ops = agg("storage", "finishedQueries")
         doc["qos"] = {
             "transactions_committed_total": txn_out,
             "conflicts_total": conflicts,
             "storage_finished_queries_total": ops,
         }
+        if committed:
+            worst_lag = max(v - d for v, d in zip(committed, durable))
+            doc["qos"]["worst_storage_durability_lag_versions"] = worst_lag
+            doc["qos"]["limiting"] = (
+                "storage_durability_lag"
+                if worst_lag > self.knobs.RK_LAG_TARGET
+                else "workload"
+            )
         # ratekeeper's released rate (master.getRate#uid on the master)
         if info is not None and info.master_address:
             try:
@@ -404,6 +584,15 @@ class ClusterController:
                     doc["qos"]["released_transactions_per_second"] = rate
             except Exception:
                 pass
+
+        # latency probes: freshest timed GRV/read/commit plus percentile
+        # stats over the probe history (Status.actor.cpp latency_probe)
+        probe = dict(self._probe_latest)
+        probe["probes_completed"] = self._c_probe_ok.value
+        probe["probe_errors"] = self._c_probe_err.value
+        for pname, sample in self.probe_stats.samples.items():
+            probe[pname + "_stats"] = sample.snapshot()
+        doc["latency_probe"] = probe
         return doc
 
     # -- client openDatabase -----------------------------------------------------

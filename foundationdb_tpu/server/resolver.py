@@ -23,6 +23,7 @@ from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
 from ..runtime.futures import Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
+from ..runtime.loop import now
 from ..runtime.stats import CounterCollection
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
 
@@ -87,6 +88,11 @@ class Resolver:
         **backend_kw,
     ):
         self.knobs = knobs or Knobs()
+        if backend in ("tpu", "tpu1", "mesh") and "capacity" not in backend_kw:
+            # thread the cluster capacity knob into the device index (the
+            # knob existed but never reached the backend — randomized sim
+            # runs silently tested the default capacity only)
+            backend_kw["capacity"] = self.knobs.CONFLICT_SET_CAPACITY
         self.cs = new_conflict_set(backend, **backend_kw)
         if first_version:
             # a post-recovery resolver starts with empty history at the
@@ -117,7 +123,16 @@ class Resolver:
         self._c_txns = self.stats.counter("transactions")
         self._c_conflicts = self.stats.counter("conflicts")
         self._c_too_old = self.stats.counter("tooOld")
+        self._l_resolve = self.stats.latency("resolveLatency")
         self.stats.gauge("version", lambda: self.gate.version)
+        # device-kernel observability: the TPU/mesh backends carry a
+        # KernelMetrics CounterCollection (per-phase wall time, overflow
+        # replays, reshard/transfer counters, occupancy). Snapshot it as a
+        # nested section so resolver.metrics / the status document / the
+        # periodic ResolverMetrics trace all carry it with no extra wiring.
+        kernel = getattr(self.cs, "metrics", None)
+        if kernel is not None:
+            self.stats.gauge("kernel", kernel.snapshot)
         # per-range load sample for resolutionBalancing
         # (Resolver.actor.cpp:276-284 iopsSample): conflict-range begin
         # keys → op counts, decayed by halving at the cap; cumulative op
@@ -172,6 +187,7 @@ class Resolver:
                 )
         if buggify():
             await delay(0.001)  # slow resolver (pipeline under jitter)
+        t_resolve = now()
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
         oldest = max(0, req.version - window)
         if self._pipelined:
@@ -219,6 +235,7 @@ class Resolver:
             verdicts = self.cs.detect_batch(
                 txns, now=req.version, new_oldest_version=oldest
             )
+        self._l_resolve.add(now() - t_resolve)
 
         if req.state_txn_indices:
             self._state_txns[req.version] = [

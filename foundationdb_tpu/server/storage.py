@@ -26,6 +26,7 @@ from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
+from ..runtime.loop import now
 from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, trace
 from ..kv.selector import SELECTOR_END
@@ -115,6 +116,9 @@ class StorageServer:
         self._c_bytes_q = self.stats.counter("bytesQueried")
         self._c_mutations = self.stats.counter("mutations")
         self._c_mutation_bytes = self.stats.counter("mutationBytes")
+        # client-observed read service time, version wait included (the
+        # reference's readLatencyBands) — feeds the status workload section
+        self._l_read = self.stats.latency("readLatency")
         self.stats.gauge("version", lambda: self.version.get())
         self.stats.gauge("durableVersion", lambda: self.durable_version)
         self.stats.gauge(
@@ -641,6 +645,7 @@ class StorageServer:
                 raise WrongShardServer()
 
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
+        t0 = now()
         if buggify():
             await delay(0.001)  # slow replica (hedging/load-balance paths)
         await self._wait_for_version(req.version)
@@ -649,12 +654,14 @@ class StorageServer:
         if not known and self.engine is not None:
             value = self.engine.read_value(req.key)
         self._c_queries.add()
+        self._l_read.add(now() - t0)
         if value is not None:
             self._c_rows.add()
             self._c_bytes_q.add(len(req.key) + len(value))
         return GetValueReply(value=value)
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
+        t0 = now()
         await self._wait_for_version(req.version)
         self._check_read(req.begin, req.end, req.version)
         # tiny replies force every caller through its `more`/windowing path
@@ -664,6 +671,7 @@ class StorageServer:
         )
         more = len(data) > limit
         self._c_queries.add()
+        self._l_read.add(now() - t0)
         self._c_rows.add(min(len(data), limit))
         self._c_bytes_q.add(sum(len(k) + len(v) for k, v in data[:limit]))
         return GetKeyValuesReply(data=data[:limit], more=more)

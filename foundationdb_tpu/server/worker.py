@@ -135,12 +135,24 @@ class Worker:
 
     async def _role_metrics(self, _req) -> dict:
         """Snapshot every hosted role's CounterCollection — the status
-        aggregator's per-process pull (Status.actor.cpp's workerEvents)."""
+        aggregator's per-process pull (Status.actor.cpp's workerEvents).
+        Counters also report `*_hz` interval rates over the current
+        metric-trace interval (the status document's tps/ops-per-second
+        workload section divides nothing itself), once enough of the
+        interval has elapsed for the rate to mean anything."""
+        from ..runtime.loop import now
+
         out = {}
         for uid, h in self.roles.items():
             stats = getattr(h.obj, "stats", None)
             if stats is not None:
-                snap = stats.snapshot()
+                elapsed = None
+                last = getattr(stats, "_last_trace", None)
+                if last is not None:
+                    dt = now() - last
+                    if dt > 0.5:
+                        elapsed = dt
+                snap = stats.snapshot(elapsed)
                 snap["kind"] = h.kind
                 out[uid] = snap
         return out
@@ -338,7 +350,12 @@ class Worker:
             consumers=tuple(consumers),
         )
         h.epoch, h.obj = epoch, tl
-        self._spawn(h, tl.stats.trace_loop(5.0, self.process.address))
+        self._spawn(
+            h,
+            tl.stats.trace_loop(
+                self.knobs.METRICS_TRACE_INTERVAL, self.process.address
+            ),
+        )
         if recover:
             # serve only after the DiskQueue replay: a peek against an
             # empty index would understate this replica's durable version
@@ -381,7 +398,12 @@ class Worker:
         lr.register_instance(self.process)
         for t in lr.tags:
             self._spawn(h, lr._pull(t))
-        self._spawn(h, lr.stats.trace_loop(5.0, self.process.address))
+        self._spawn(
+            h,
+            lr.stats.trace_loop(
+                self.knobs.METRICS_TRACE_INTERVAL, self.process.address
+            ),
+        )
 
     def _make_resolver(self, h, backend="oracle", first_version=0, epoch=0):
         from .resolver import Resolver
@@ -391,7 +413,12 @@ class Worker:
         )
         h.epoch, h.obj = epoch, r
         r.register_instance(self.process)
-        self._spawn(h, r.stats.trace_loop(5.0, self.process.address))
+        self._spawn(
+            h,
+            r.stats.trace_loop(
+                self.knobs.METRICS_TRACE_INTERVAL, self.process.address
+            ),
+        )
 
     def _make_proxy(
         self,
@@ -423,7 +450,12 @@ class Worker:
         pr.register_instance(self.process)
         self._spawn(h, pr.batcher_loop())
         self._spawn(h, pr.rate_poller())
-        self._spawn(h, pr.stats.trace_loop(5.0, self.process.address))
+        self._spawn(
+            h,
+            pr.stats.trace_loop(
+                self.knobs.METRICS_TRACE_INTERVAL, self.process.address
+            ),
+        )
 
     def _make_storage(
         self, h, tag=0, ranges=None, recover=False, seed=False, remote=False
@@ -493,7 +525,12 @@ class Worker:
         )
         h.obj = ss
         ss.register_endpoints(self.process)
-        self._spawn(h, ss.stats.trace_loop(5.0, self.process.address))
+        self._spawn(
+            h,
+            ss.stats.trace_loop(
+                self.knobs.METRICS_TRACE_INTERVAL, self.process.address
+            ),
+        )
         if recover:
             self._spawn(h, ss.run())
         else:

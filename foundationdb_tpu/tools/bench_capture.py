@@ -108,10 +108,28 @@ def snapshot(result, platform):
         entry = best
     tmp = PARTIAL + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(entry, f, indent=1)
+        json.dump(entry, f, indent=1, default=str)
         f.write("\n")
     os.replace(tmp, PARTIAL)
     log("snapshot: vs_baseline=%s -> %s" % (entry.get("vs_baseline"), PARTIAL))
+    # kernel counter provenance (bench.py embeds its KernelMetrics
+    # snapshot): a capture that paid overflow replays or reshard churn
+    # says so next to its number
+    k = entry.get("kernel") or {}
+    if k:
+        occ = k.get("occupancy") or {}
+        log(
+            "kernel: replays=%s reshards=%s+%s liveRows=%s fill=%s h2d=%sB d2h=%sB"
+            % (
+                k.get("overflowReplays"),
+                k.get("reshardsDevice"),
+                k.get("reshardsHost"),
+                occ.get("liveRows"),
+                occ.get("fillFraction"),
+                k.get("hostToDeviceBytes"),
+                k.get("deviceToHostBytes"),
+            )
+        )
 
 
 _EVIDENCE_DONE = False

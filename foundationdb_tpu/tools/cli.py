@@ -112,6 +112,40 @@ class FdbCli:
         proxies = doc.get("client", {}).get("proxies")
         if proxies:
             lines.append(f"Proxies: {', '.join(proxies)}")
+        probe = doc.get("latency_probe") or {}
+        if probe.get("commit_seconds") is not None:
+            lines.append(
+                "Latency probe: GRV "
+                f"{probe.get('grv_seconds', 0) * 1000:.1f} ms, read "
+                f"{probe.get('read_seconds', 0) * 1000:.1f} ms, commit "
+                f"{probe.get('commit_seconds', 0) * 1000:.1f} ms "
+                f"({probe.get('probes_completed', 0)} probes, "
+                f"{probe.get('probe_errors', 0)} errors)"
+            )
+        wl = doc.get("workload") or {}
+        tx = wl.get("transactions") or {}
+        if tx:
+            def hz(section):
+                return (tx.get(section) or {}).get("hz") or 0
+
+            lines.append(
+                f"Workload: {hz('started'):.0f} started/s, "
+                f"{hz('committed'):.0f} committed/s, "
+                f"{hz('conflicted'):.0f} conflicted/s"
+            )
+        qos = doc.get("qos") or {}
+        if qos:
+            rate = qos.get("released_transactions_per_second")
+            lines.append(
+                f"QoS: {qos.get('transactions_committed_total', 0)} committed, "
+                f"{qos.get('conflicts_total', 0)} conflicts"
+                + (f", released rate {rate:.0f} tps" if rate else "")
+                + (
+                    f", limiting: {qos['limiting']}"
+                    if qos.get("limiting")
+                    else ""
+                )
+            )
         if args and args[0] == "details":
             # machine/process sections (fdbcli `status details`)
             machines = doc.get("machines", {})
@@ -145,6 +179,27 @@ class FdbCli:
                     "Data: storage version spread "
                     f"{data.get('storage_version_spread', 0)}"
                 )
+            resolvers = doc.get("resolvers") or {}
+            if resolvers:
+                lines.append("")
+                lines.append(f"{len(resolvers)} resolvers:")
+                for uid, snap in sorted(resolvers.items()):
+                    k = snap.get("kernel") or {}
+                    occ = (k.get("occupancy") or {}) if k else {}
+                    extra = (
+                        f"  kernel: {occ.get('liveRows', 0)} rows "
+                        f"{occ.get('fillFraction', 0):.1%} full, "
+                        f"{k.get('overflowReplays', 0)} replays, "
+                        f"{k.get('reshardsDevice', 0)}+"
+                        f"{k.get('reshardsHost', 0)} reshards"
+                        if k
+                        else ""
+                    )
+                    lines.append(
+                        f"  {uid} @ {snap.get('address', '?')}: "
+                        f"{snap.get('transactions', 0)} txns, "
+                        f"{snap.get('conflicts', 0)} conflicts{extra}"
+                    )
         return "\n".join(lines)
 
     async def _cmd_exclude(self, args) -> str:

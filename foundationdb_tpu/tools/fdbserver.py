@@ -68,11 +68,6 @@ def main(argv=None) -> int:
     from ..net.tcp import RealWorld
     from ..runtime.knobs import Knobs
 
-    if args.tracefile:
-        from ..runtime.trace import TraceLog, set_trace_log
-
-        set_trace_log(TraceLog(args.tracefile))
-
     knob_overrides = {}
     for kv in args.knob:
         name, _, val = kv.partition("=")
@@ -85,6 +80,19 @@ def main(argv=None) -> int:
                 parsed = val
         knob_overrides[name.upper()] = parsed
     knobs = Knobs(**knob_overrides)
+
+    if args.tracefile:
+        from ..runtime.trace import TraceLog, set_trace_log
+
+        # knob-controlled size-based rolling (the reference's 10 MB
+        # trace_roll_size); rolled files are what trace_analyze consumes
+        set_trace_log(
+            TraceLog(
+                args.tracefile,
+                max_file_bytes=knobs.TRACE_ROLL_BYTES,
+                keep_files=knobs.TRACE_ROLL_KEEP,
+            )
+        )
 
     tls = None
     if args.tls_cert or args.tls_key or args.tls_ca:

@@ -1,0 +1,148 @@
+"""Trace-file consumer: summarize a JSONL trace (plus its rolled
+siblings) the way an operator reads the reference's XML traces — event
+rates, the loudest SevWarn+ types, and per-role metrics timelines from
+the periodic ``*Metrics`` CounterCollection events.
+
+  python -m foundationdb_tpu.tools.trace_analyze trace.jsonl [--top N]
+
+`analyze()` / `format_summary()` are importable so tests and other tools
+(the status pipeline's consumers) use the same aggregation the CLI
+prints."""
+
+from __future__ import annotations
+
+import json
+import os
+
+_META_FIELDS = ("Severity", "Type", "Time", "Machine", "ID", "Elapsed")
+_WARN_SEVERITIES = ("Warn", "WarnAlways", "Error")
+
+
+def load_events(path: str, keep_files: int = 10) -> list[dict]:
+    """Events from ``path`` and any rolled siblings (path.N oldest first,
+    then the live file) — one roll must not hide the run's history."""
+    paths = [
+        f"{path}.{i}" for i in range(keep_files, 0, -1) if os.path.exists(f"{path}.{i}")
+    ]
+    if os.path.exists(path):
+        paths.append(path)
+    events = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # a roll can truncate the last line
+    return events
+
+
+def analyze(events: list[dict], top: int = 10) -> dict:
+    """Aggregate a trace into the operator summary (pure function)."""
+    by_type: dict[str, int] = {}
+    by_severity: dict[str, int] = {}
+    warn_types: dict[str, int] = {}
+    times = []
+    timelines: dict[str, dict] = {}
+    for e in events:
+        t = e.get("Type", "?")
+        by_type[t] = by_type.get(t, 0) + 1
+        sev = str(e.get("Severity", "?"))
+        by_severity[sev] = by_severity.get(sev, 0) + 1
+        if sev in _WARN_SEVERITIES:
+            warn_types[t] = warn_types.get(t, 0) + 1
+        when = e.get("Time")
+        if isinstance(when, (int, float)):
+            times.append(when)
+        if t.endswith("Metrics"):
+            key = f"{t}#{e.get('ID') or e.get('Machine') or ''}"
+            tl = timelines.setdefault(
+                key,
+                {
+                    "points": 0,
+                    "first_time": when,
+                    "last_time": when,
+                    "first": {},
+                    "last": {},
+                },
+            )
+            tl["points"] += 1
+            tl["last_time"] = when
+            for k, v in e.items():
+                if k in _META_FIELDS or not isinstance(v, (int, float)):
+                    continue
+                tl["first"].setdefault(k, v)
+                tl["last"][k] = v
+    span = (max(times) - min(times)) if len(times) > 1 else 0.0
+    top_types = sorted(by_type.items(), key=lambda kv: -kv[1])[:top]
+    top_warns = sorted(warn_types.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "events": len(events),
+        "time_span_seconds": round(span, 3),
+        "events_per_second": round(len(events) / span, 2) if span > 0 else None,
+        "by_severity": by_severity,
+        "top_types": top_types,
+        "top_warn_types": top_warns,
+        "timelines": timelines,
+    }
+
+
+def format_summary(summary: dict) -> str:
+    lines = [
+        f"{summary['events']} events over {summary['time_span_seconds']}s"
+        + (
+            f" ({summary['events_per_second']}/s)"
+            if summary["events_per_second"]
+            else ""
+        ),
+        "severity: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(summary["by_severity"].items())),
+        "",
+        "top event types:",
+    ]
+    for t, n in summary["top_types"]:
+        lines.append(f"  {n:8d}  {t}")
+    if summary["top_warn_types"]:
+        lines.append("")
+        lines.append("top SevWarn+ types:")
+        for t, n in summary["top_warn_types"]:
+            lines.append(f"  {n:8d}  {t}")
+    if summary["timelines"]:
+        lines.append("")
+        lines.append("role metrics timelines (counter deltas first→last):")
+        for key, tl in sorted(summary["timelines"].items()):
+            deltas = []
+            for k, last in tl["last"].items():
+                first = tl["first"].get(k, 0)
+                if isinstance(last, (int, float)) and last != first:
+                    deltas.append(f"{k}+{round(last - first, 3)}")
+            span = (tl["last_time"] or 0) - (tl["first_time"] or 0)
+            lines.append(
+                f"  {key}: {tl['points']} points over {round(span, 1)}s  "
+                + (" ".join(deltas[:8]) if deltas else "(no movement)")
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="trace-analyze")
+    ap.add_argument("trace", help="JSONL trace file (rolled siblings included)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    summary = analyze(events, top=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,56 @@ def test_every_test_module_imports():
     )
 
 
+def test_every_server_role_registers_metrics():
+    """Metrics-registration lint: every server role class must expose a
+    CounterCollection (`self.stats = CounterCollection(...)`) and register
+    a `<role>.metrics#<uid>` endpoint, so new roles can't ship dark — the
+    status pipeline aggregates exactly these (worker._role_metrics +
+    Status's per-role pulls)."""
+    import inspect
+    import re
+
+    from foundationdb_tpu.server import worker as worker_mod
+
+    # role kind → class, mirroring Worker._make_* dispatch. `master` is a
+    # transient recovery-coordinator actor FUNCTION (its long-lived
+    # subsystems — DD, Ratekeeper — live behind master.* endpoints), so it
+    # is exempt by design, not by omission.
+    from foundationdb_tpu.server.log_router import LogRouter
+    from foundationdb_tpu.server.proxy import Proxy
+    from foundationdb_tpu.server.resolver import Resolver
+    from foundationdb_tpu.server.storage import StorageServer
+    from foundationdb_tpu.server.tlog import TLog
+
+    role_classes = {
+        "tlog": TLog,
+        "log_router": LogRouter,
+        "resolver": Resolver,
+        "proxy": Proxy,
+        "storage": StorageServer,
+    }
+    exempt = {"master"}
+
+    # the registry above must cover every recruitable role kind: a new
+    # _make_<role> without a lint entry fails here first
+    kinds = set(
+        re.findall(r"def _make_(\w+)\(", inspect.getsource(worker_mod.Worker))
+    )
+    missing = kinds - set(role_classes) - exempt
+    assert not missing, f"role kinds without a metrics-lint entry: {missing}"
+
+    for kind, cls in role_classes.items():
+        src = inspect.getsource(cls)
+        assert re.search(r"self\.stats\s*=\s*CounterCollection\(", src), (
+            f"{kind}: role class {cls.__name__} has no CounterCollection — "
+            f"its traffic would be invisible to status/trace"
+        )
+        assert re.search(r"\.metrics#", src), (
+            f"{kind}: role class {cls.__name__} registers no *.metrics# "
+            f"endpoint — the status aggregator could not pull it"
+        )
+
+
 def test_acceptance_batteries_not_slow_marked():
     for name in TIER1_PINNED:
         path = TESTS / name

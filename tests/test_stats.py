@@ -34,6 +34,22 @@ def test_latency_sample_percentiles():
     assert snap["count"] == 100 and snap["p99"] >= snap["p50"]
 
 
+def test_latency_sample_sorts_once_per_snapshot():
+    s = LatencySample("lat", cap=16)
+    for v in (5.0, 1.0, 3.0):
+        s.add(v)
+    assert s._sorted is None  # dirty until first read
+    snap = s.snapshot()
+    assert snap["p50"] == 3.0
+    cached = s._sorted
+    assert cached is not None
+    s.percentile(0.5)
+    assert s._sorted is cached  # reads share one sorted buffer
+    s.add(0.5)
+    assert s._sorted is None  # adds invalidate the cache
+    assert s.percentile(0.0) == 0.5
+
+
 def test_latency_sample_reservoir_bounded():
     s = LatencySample("lat", cap=64)
     for i in range(10000):

@@ -1,0 +1,124 @@
+"""Cluster-wide status pipeline (ISSUE 5): latency probes, workload/qos
+sections, and TPU conflict-kernel metrics end-to-end.
+
+One sim cluster (TPU backend on the CPU twin, tiny CONFLICT_SET_CAPACITY)
+serves every assertion: `status json` carries populated `latency_probe`,
+`workload`, `qos`, and per-resolver kernel sections with sane value
+ranges, and a flood of brand-new keys forces overflow replays that must
+surface in BOTH `resolver.metrics` and the status document."""
+
+from foundationdb_tpu.client import management
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Endpoint, Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+
+
+def test_status_pipeline_end_to_end():
+    sim = Sim(seed=61)
+    sim.activate()
+    # tiny device index: the key floods below must outgrow some bucket's
+    # slot budget and pay an overflow replay (the knob now actually
+    # reaches the backend through the resolver)
+    sim.knobs.CONFLICT_SET_CAPACITY = 16
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(
+            n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=2,
+            conflict_backend="tpu1",
+        ),
+        n_coordinators=1,
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def body():
+        # normal traffic for the workload/qos counters
+        for i in range(25):
+
+            async def w(tr, i=i):
+                tr.set(b"sp%02d" % i, b"v")
+
+            await db.run(w)
+
+        # key floods with fresh prefixes: each lands past the previously
+        # sampled pivots, concentrating >S2 staged rows in one bucket
+        for prefix in (b"ov", b"pw", b"qx"):
+
+            async def flood(tr, prefix=prefix):
+                for i in range(150):
+                    tr.set(prefix + b"%04d" % i, b"x")
+
+            await db.run(flood)
+
+        # let probes + per-role metric trace loops fire a few times
+        await delay(8.0)
+        doc = await management.get_status(cluster.coordinators, db.client)
+
+        # resolver.metrics endpoint (the role's own wire answer) must show
+        # the same replay counter the status doc aggregates
+        direct = {}
+        for addr, p in sim.processes.items():
+            w = getattr(p, "worker", None)
+            if w is None or not p.alive:
+                continue
+            for uid, h in w.roles.items():
+                if h.kind == "resolver":
+                    direct[uid] = await db.client.request(
+                        Endpoint(addr, f"resolver.metrics#{uid}"), None
+                    )
+        return doc, direct
+
+    doc, direct = sim.run_until_done(spawn(body()), 900.0)
+
+    # -- latency_probe: timed GRV/read/commit with sane sim-time ranges
+    probe = doc["latency_probe"]
+    assert probe["probes_completed"] > 0
+    for leg in ("grv_seconds", "read_seconds", "commit_seconds"):
+        assert 0 < probe[leg] < 5.0, (leg, probe)
+    for leg in ("grv", "read", "commit"):
+        stats = probe[leg + "_stats"]
+        assert stats["count"] > 0 and 0 < stats["p50"] < 5.0, (leg, stats)
+
+    # -- workload: tps/ops aggregated from proxy + storage counters
+    wl = doc["workload"]
+    assert wl["transactions"]["committed"]["counter"] >= 28
+    assert wl["transactions"]["started"]["counter"] > 0
+    assert wl["operations"]["writes"]["counter"] >= 25 + 3 * 150
+    assert wl["operations"]["bytes_written"]["counter"] > 0
+    assert wl["operations"]["reads"]["counter"] >= 0
+
+    # -- qos: totals + ratekeeper rate + durability-lag roll-up
+    qos = doc["qos"]
+    assert qos["transactions_committed_total"] >= 28
+    assert qos.get("released_transactions_per_second", 0) > 0
+    assert qos["worst_storage_durability_lag_versions"] >= 0
+    assert qos["limiting"] in ("workload", "storage_durability_lag")
+
+    # -- per-resolver kernel sections with occupancy + forced replays
+    assert doc["resolvers"], doc.keys()
+    replay_total = 0
+    for uid, snap in doc["resolvers"].items():
+        assert snap["resolveBatchIn"] > 0
+        k = snap["kernel"]
+        assert k["txns"] >= 28
+        assert k["jitCacheMisses"] > 0
+        assert k["hostToDeviceBytes"] > 0 and k["deviceToHostBytes"] > 0
+        occ = k["occupancy"]
+        assert 0 < occ["liveRows"] <= occ["bucketCount"] * occ["slotCapacity"]
+        assert 0 <= occ["fillFraction"] <= 1.0
+        assert k["encodeSeconds"]["count"] > 0
+        assert k["collectSeconds"]["count"] > 0
+        replay_total += k["overflowReplays"]
+    assert replay_total > 0, "key floods should have forced an overflow replay"
+
+    # -- the role's own resolver.metrics endpoint agrees
+    assert direct
+    assert sum(s["kernel"]["overflowReplays"] for s in direct.values()) > 0
+    for s in direct.values():
+        assert s["kernel"]["occupancy"]["liveRows"] > 0
+
+    # machine/process sections carry both memory views (current + peak)
+    assert doc["processes"]
+    for sm in doc["processes"].values():
+        assert sm["MemoryKB"] > 0
+        assert sm["PeakMemoryKB"] > 0
