@@ -14,6 +14,7 @@ from ..errors import WrongShardServer
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay, settled, wait_for_any
 from ..runtime.loop import Cancelled, now
+from ..runtime.trace import span
 
 _ROTATE = (BrokenPromise, WrongShardServer)
 
@@ -88,17 +89,23 @@ async def load_balanced_request(db, team, token: str, req, hedge: bool = True):
         d = model.get(addr)
         d.begin()
         t0 = now()
-        try:
-            r = await db.client.request(Endpoint(addr, token), req)
-            d.end(now() - t0, True)
-            return r
-        except Cancelled:
-            # hedge loser: losing a race is not a replica failure
-            d.outstanding = max(0, d.outstanding - 1)
-            raise
-        except BaseException:
-            d.end(now() - t0, False)
-            raise
+        # per-attempt RPC span (runtime/trace.py): every replica try —
+        # hedges and failures included — shows in the trace waterfall, so
+        # wire time is the gap between this span and the server's
+        with span("Client.rpc", "client", replica=addr, op=token) as sp:
+            try:
+                r = await db.client.request(Endpoint(addr, token), req)
+                d.end(now() - t0, True)
+                return r
+            except Cancelled:
+                # hedge loser: losing a race is not a replica failure
+                sp.tag(outcome="hedge_lost")
+                d.outstanding = max(0, d.outstanding - 1)
+                raise
+            except BaseException as e:
+                sp.tag(outcome=type(e).__name__)
+                d.end(now() - t0, False)
+                raise
 
     i = 0
     while i < len(order):

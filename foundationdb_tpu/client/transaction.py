@@ -35,6 +35,7 @@ from ..kv.mutations import Mutation, MutationType
 from ..kv.selector import SELECTOR_END, KeySelector, as_selector
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay
+from ..runtime.trace import NULL_SPAN as _NO_SPAN, annotate as _annotate
 from .loadbalance import load_balanced_request
 from ..runtime.buggify import buggify
 from ..server.interfaces import (
@@ -82,18 +83,66 @@ class Transaction:
         self.versionstamp: Optional[bytes] = None
         # transaction-debug attach id (fdb_transaction_set_option
         # DEBUG_TRANSACTION_IDENTIFIER + the commit sampler): every
-        # pipeline stage traces CommitDebug events with it
+        # pipeline stage traces CommitDebug events with it. The same id
+        # names the transaction's distributed trace (runtime/trace.py) —
+        # a sampled transaction's spans and its debug chain share it.
         self.debug_id: str = ""
+        self._span_root = None  # SpanContext once sampled
+        self._trace_decided = False
 
     def set_debug_id(self, debug_id: str) -> None:
         self.debug_id = debug_id
+
+    # -- distributed-trace sampling (TRACE_SAMPLE_RATE / debug ids) ------------
+
+    def _trace_root(self):
+        """This transaction's root span context, deciding sampling on
+        first use: an explicit debug id forces sampling; otherwise one
+        seeded-RNG draw against TRACE_SAMPLE_RATE (no draw at rate 0, so
+        untraced runs consume an identical random stream)."""
+        if not self._trace_decided:
+            self._trace_decided = True
+            if not self.debug_id:
+                rate = getattr(self.db.knobs, "TRACE_SAMPLE_RATE", 0.0)
+                if rate > 0.0 and self.db.rng.random01() < rate:
+                    self.debug_id = f"txn-{self.db.rng.random_unique_id()}"
+            if self.debug_id:
+                from ..runtime.trace import root_context
+
+                self._span_root = root_context(self.debug_id)
+        elif self._span_root is None and self.debug_id:
+            # debug id attached after the sampling decision (late
+            # set_debug_id): still trace
+            from ..runtime.trace import root_context
+
+            self._span_root = root_context(self.debug_id)
+        return self._span_root
+
+    def _op_span(self, name: str, **tags):
+        """A client-op span: child of the enclosing op when one is active
+        (selector endpoints resolving inside getRange), else of the
+        transaction root. None when this transaction is unsampled — the
+        callers keep their untraced fast path."""
+        root = self._trace_root()
+        if root is None:
+            return None
+        from ..runtime.trace import active_span, span
+
+        return span(name, "client", parent=active_span() or root, **tags)
 
     # -- read version ----------------------------------------------------------
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            # batched through the database's readVersionBatcher
-            self._read_version = await self.db.get_read_version()
+            sp = self._op_span("Client.getReadVersion")
+            if sp is None:
+                # batched through the database's readVersionBatcher
+                self._read_version = await self.db.get_read_version()
+            else:
+                with sp:
+                    sp.event("ClientGRVStart", kind="ReadDebug")
+                    self._read_version = await self.db.get_read_version()
+                    sp.event("ClientGRVDone", kind="ReadDebug")
         return self._read_version
 
     def set_read_version(self, version: int) -> None:
@@ -170,6 +219,17 @@ class Transaction:
     # -- reads -----------------------------------------------------------------
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        sp = self._op_span("Client.get")
+        if sp is None:
+            return await self._get_impl(key, snapshot)
+        with sp:
+            sp.event("ClientReadStart", kind="ReadDebug")
+            try:
+                return await self._get_impl(key, snapshot)
+            finally:
+                sp.event("ClientReadDone", kind="ReadDebug")
+
+    async def _get_impl(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         if key in self._unreadable:
             raise AccessedUnreadable()
         w = self._writes.get(key)
@@ -202,6 +262,13 @@ class Transaction:
         return v
 
     async def get_key(self, selector, snapshot: bool = False) -> bytes:
+        sp = self._op_span("Client.getKey")
+        if sp is None:
+            return await self._get_key_impl(selector, snapshot)
+        with sp:
+            return await self._get_key_impl(selector, snapshot)
+
+    async def _get_key_impl(self, selector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (kv/selector.py) to an existing key at
         the read version, seen through the RYW overlay — this txn's
         uncommitted sets add keys to the walk and its clears remove them
@@ -286,6 +353,26 @@ class Transaction:
         reverse: bool = False,
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
+        sp = self._op_span("Client.getRange")
+        if sp is None:
+            return await self._get_range_impl(begin, end, limit, reverse, snapshot)
+        with sp:
+            sp.event("ClientReadStart", kind="ReadDebug")
+            try:
+                rows = await self._get_range_impl(begin, end, limit, reverse, snapshot)
+                sp.tag(rows=len(rows))
+                return rows
+            finally:
+                sp.event("ClientReadDone", kind="ReadDebug")
+
+    async def _get_range_impl(
+        self,
+        begin,
+        end,
+        limit: int = 1 << 30,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
         if isinstance(begin, KeySelector) or isinstance(end, KeySelector):
             # selector endpoints resolve first (snapshot resolution — the
             # range read below conflict-protects the resolved range), then
@@ -303,7 +390,7 @@ class Transaction:
             )
             if b >= e:
                 return []
-            return await self.get_range(
+            return await self._get_range_impl(
                 b, e, limit=limit, reverse=reverse, snapshot=snapshot
             )
         assert not reverse or limit < (1 << 30), "reverse needs a limit"
@@ -439,11 +526,13 @@ class Transaction:
                 version_retries += 1
                 if version_retries > 20:
                     raise
+                _annotate("ClientReadRetry", "client", Err="FutureVersion")
                 await delay(FUTURE_VERSION_RETRY_DELAY)
             except (BrokenPromise, WrongShardServer) as e:
                 # whole team unreachable or moved: drop cache, back off,
                 # re-locate
                 last_err = e
+                _annotate("ClientReadRetry", "client", Err=type(e).__name__)
                 self.db.invalidate_cache(key, before=before)
                 await delay(0.1)
         raise last_err or BrokenPromise("read retries exhausted")
@@ -467,32 +556,24 @@ class Transaction:
             mutations=self._mutations,
             debug_id=self.debug_id,
         )
-        if self.debug_id:
-            from ..runtime.trace import SevInfo, trace
-
-            trace(
-                SevInfo, "CommitDebug", "client",
-                Id=self.debug_id, Event="ClientCommitStart",
-            )
-        if buggify():
-            await delay(0.002)  # commit racing a concurrent writer
-        try:
-            reply = await self.db._proxy_request(
-                Tokens.COMMIT, CommitRequest(transaction=data), retry=False
-            )
-        except (NotCommitted, TransactionTooOld):
-            raise
-        except BrokenPromise:
-            raise CommitUnknownResult()
-        self.committed_version = reply.version
-        self.versionstamp = reply.versionstamp
-        if self.debug_id:
-            from ..runtime.trace import SevInfo, trace
-
-            trace(
-                SevInfo, "CommitDebug", "client",
-                Id=self.debug_id, Event="ClientCommitDone",
-            )
+        sp = self._op_span("Client.commit", mutations=len(self._mutations))
+        with sp if sp is not None else _NO_SPAN:
+            if sp is not None:
+                sp.event("ClientCommitStart")
+            if buggify():
+                await delay(0.002)  # commit racing a concurrent writer
+            try:
+                reply = await self.db._proxy_request(
+                    Tokens.COMMIT, CommitRequest(transaction=data), retry=False
+                )
+            except (NotCommitted, TransactionTooOld):
+                raise
+            except BrokenPromise:
+                raise CommitUnknownResult()
+            self.committed_version = reply.version
+            self.versionstamp = reply.versionstamp
+            if sp is not None:
+                sp.event("ClientCommitDone")
         self._start_watches()
         return reply.version
 

@@ -178,7 +178,15 @@ class Sim:
     def request(self, src: str, ep: Endpoint, payload: Any) -> Future:
         """One RPC: request and reply each traverse the simulated network.
         The reply future errors with BrokenPromise if the destination is dead
-        or unreachable — callers retry exactly like the reference's clients."""
+        or unreachable — callers retry exactly like the reference's clients.
+
+        The caller's active span context rides the envelope (the analog of
+        FlowTransport attaching the span to the packet header): the handler
+        actor is spawned under it, so server-side spans become children of
+        the client's without any request dataclass carrying trace fields."""
+        from ..runtime import trace as _trace
+
+        span_ctx = _trace.active_span()
         reply: Future = Future()
 
         def deliver():
@@ -205,7 +213,11 @@ class Sim:
                     return
                 self._reply_ok(ep.address, src, reply, result)
 
-            dst.spawn(run_and_reply())
+            prev = _trace.swap_active_span(span_ctx)
+            try:
+                dst.spawn(run_and_reply())
+            finally:
+                _trace.swap_active_span(prev)
 
         if not self._deliverable(src, ep.address):
             # dropped on the floor: the caller's timeout/failure monitor acts

@@ -592,13 +592,18 @@ class RealWorld:
     # -- RPC -------------------------------------------------------------------
 
     def request(self, ep: Endpoint, payload: Any) -> Future:
+        from ..runtime import trace as _trace
+
         reply: Future = Future()
         if ep.address == self.node.address:
             self._dispatch_local(ep.token, payload, reply)
             return reply
         rid = self._next_id
         self._next_id += 1
-        msg = ("req", rid, ep.token, payload)
+        # the caller's span context rides the request tuple (the analog of
+        # FlowTransport's SpanContextMessage): the remote handler runs as a
+        # child of the caller's span without the payload knowing
+        msg = ("req", rid, ep.token, payload, wire.pack_span_context(_trace.active_span()))
         conn = self._conns.get(ep.address)
         if conn is not None:
             self._pending[rid] = (reply, ep.address)
@@ -648,11 +653,12 @@ class RealWorld:
     def _on_message(self, conn: _Conn, msg) -> None:
         kind = msg[0]
         if kind == "req":
-            _k, rid, token, payload = msg
+            _k, rid, token, payload, *rest = msg
             handler = self.node.endpoints.get(token)
             if handler is None:
                 conn.send(("err", rid, "broken_promise", token))
                 return
+            span_ctx = wire.unpack_span_context(rest[0]) if rest else None
 
             async def run_and_reply(rid=rid, handler=handler, payload=payload):
                 try:
@@ -676,7 +682,13 @@ class RealWorld:
                     return
                 conn.send(("ok", rid, result))
 
-            self.node.spawn(run_and_reply())
+            from ..runtime import trace as _trace
+
+            prev = _trace.swap_active_span(span_ctx)
+            try:
+                self.node.spawn(run_and_reply())
+            finally:
+                _trace.swap_active_span(prev)
         elif kind == "ok":
             _k, rid, value = msg
             ent = self._pending.pop(rid, None)

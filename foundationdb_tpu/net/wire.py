@@ -25,6 +25,7 @@ import dataclasses
 import enum
 import struct
 import zlib
+from typing import Optional
 
 from ..runtime.serialize import PROTOCOL_VERSION
 
@@ -388,6 +389,25 @@ def decode_frames(buf: bytearray):
         pos += _FRAME.size + length
     del buf[:pos]
     return out
+
+
+def pack_span_context(ctx) -> Optional[tuple]:
+    """Span context → wire shape (None when the caller is unsampled).
+    The envelope field the real-TCP request tuple carries — the analog of
+    FlowTransport's SpanContextMessage ahead of the request packet."""
+    if ctx is None:
+        return None
+    return (ctx.trace_id, ctx.span_id)
+
+
+def unpack_span_context(v):
+    """Wire shape → SpanContext (tolerates None / malformed: tracing must
+    never turn a valid request into an error)."""
+    if not isinstance(v, (tuple, list)) or len(v) != 2:
+        return None
+    from ..runtime.trace import SpanContext
+
+    return SpanContext(str(v[0]), str(v[1]))
 
 
 def handshake_bytes(listen_addr: str) -> bytes:

@@ -19,6 +19,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Awaitable, Callable, Generic, Optional, TypeVar
 
+from . import trace as _trace
 from .loop import Cancelled, TaskPriority, current_loop
 
 T = TypeVar("T")
@@ -118,6 +119,10 @@ class Task:
         # coroutines are garbage-collected while a new simulation runs,
         # their finalizers must not leak callbacks into the new world
         self.loop = current_loop()
+        # trace-span inheritance (runtime/trace.py): a spawned actor runs
+        # inside the spawner's active span context; each step saves the
+        # (possibly changed) context back so it survives awaits
+        self._span_ctx = _trace.active_span()
 
     def start(self) -> Future:
         self.loop.call_soon(lambda: self._step(None, None), self.priority)
@@ -135,6 +140,15 @@ class Task:
         if self.future.is_ready():
             return
         self._waiting_on = None
+        prev_span = _trace.swap_active_span(self._span_ctx)
+        try:
+            self._step_inner(value, error)
+        finally:
+            # latch whatever context the body left active (a span opened
+            # across this await) and restore the interrupted one
+            self._span_ctx = _trace.swap_active_span(prev_span)
+
+    def _step_inner(self, value, error) -> None:
         try:
             if error is not None:
                 awaited = self.coro.throw(error)

@@ -72,6 +72,10 @@ class Knobs:
     # observability
     TRACE_ROLL_BYTES = 10 << 20  # roll the JSONL trace file here (reference: 10 MB)
     TRACE_ROLL_KEEP = 10  # rolled files kept (path.1 .. path.N)
+    # fraction of client transactions that open a sampled distributed
+    # trace (runtime/trace.py spans; drawn from the client's seeded RNG so
+    # same-seed sim runs sample identical trace_ids)
+    TRACE_SAMPLE_RATE = 0.0
     LATENCY_PROBE_INTERVAL = 1.0  # CC's timed GRV/read/commit probe cadence
     METRICS_TRACE_INTERVAL = 5.0  # per-role CounterCollection trace cadence
     # client

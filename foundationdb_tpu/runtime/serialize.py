@@ -18,7 +18,9 @@ import struct
 # gen 2: GetCommitVersionRequest.applied_changes_version +
 #        GetCommitVersionReply.resolver_changes[,_version]
 # gen 3: TransactionData.debug_id (transaction debug chains)
-PROTOCOL_VERSION = 0x0FDB00B070010003
+# gen 4: request tuples carry a span-context envelope field
+#        (distributed tracing; net/tcp.py "req" messages)
+PROTOCOL_VERSION = 0x0FDB00B070010004
 
 
 class BinaryWriter:

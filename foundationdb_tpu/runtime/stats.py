@@ -107,6 +107,57 @@ class LatencySample:
         }
 
 
+# default band edges (seconds) — the reference's LatencyBands knob
+# thresholds scaled to this system's sim/TCP latency envelope: sub-ms
+# fast path through multi-second stalls
+DEFAULT_BAND_EDGES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.1, 0.5, 2.0)
+
+
+class LatencyBands:
+    """Fixed-threshold latency histogram (flow/Stats.h LatencyBands /
+    fdbserver's GRV+commit+read latency bands): each request lands in the
+    first band whose upper edge covers it, overflow in ``inf``. Unlike the
+    reservoir LatencySample this never forgets — band counts are exact
+    over the role's lifetime, which is what per-endpoint SLO accounting
+    needs."""
+
+    __slots__ = ("name", "edges", "counts", "overflow", "count")
+
+    def __init__(self, name: str, edges: tuple = DEFAULT_BAND_EDGES):
+        self.name = name
+        self.edges = tuple(edges)
+        self.counts = [0] * len(self.edges)
+        self.overflow = 0
+        self.count = 0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        for i, edge in enumerate(self.edges):
+            if dt <= edge:
+                self.counts[i] += 1
+                return
+        self.overflow += 1
+
+    def snapshot(self) -> dict:
+        bands = {f"{edge:g}": n for edge, n in zip(self.edges, self.counts)}
+        bands["inf"] = self.overflow
+        return {"count": self.count, "bands": bands}
+
+    @staticmethod
+    def merge(snaps: list) -> dict:
+        """Aggregate band snapshots from many roles (the status document
+        sums per-endpoint bands cluster-wide)."""
+        total = 0
+        bands: dict[str, int] = {}
+        for s in snaps:
+            if not s:
+                continue
+            total += s.get("count", 0)
+            for edge, n in (s.get("bands") or {}).items():
+                bands[edge] = bands.get(edge, 0) + n
+        return {"count": total, "bands": bands}
+
+
 class CounterCollection:
     """A role's counters + samples, traced as one periodic event
     (CounterCollection::logToTraceEvent, flow/Stats.cpp)."""
@@ -116,6 +167,7 @@ class CounterCollection:
         self.id = ident
         self.counters: dict[str, Counter] = {}
         self.samples: dict[str, LatencySample] = {}
+        self.band_sets: dict[str, LatencyBands] = {}
         self.gauges: dict[str, object] = {}  # name → zero-arg callable
         self._last_trace = None
 
@@ -131,6 +183,12 @@ class CounterCollection:
             s = self.samples[name] = LatencySample(name, cap)
         return s
 
+    def bands(self, name: str, edges: tuple = DEFAULT_BAND_EDGES) -> LatencyBands:
+        b = self.band_sets.get(name)
+        if b is None:
+            b = self.band_sets[name] = LatencyBands(name, edges)
+        return b
+
     def gauge(self, name: str, fn) -> None:
         """Register a zero-arg callable polled at snapshot/trace time
         (the reference's SpecialCounter, flow/Stats.h:121)."""
@@ -144,6 +202,8 @@ class CounterCollection:
                 out[n + "_hz"] = round(c.interval_delta / elapsed, 2)
         for n, s in self.samples.items():
             out[n] = s.snapshot()
+        for n, b in self.band_sets.items():
+            out[n] = b.snapshot()
         for n, fn in self.gauges.items():
             try:
                 out[n] = fn()

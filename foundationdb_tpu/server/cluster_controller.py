@@ -535,6 +535,18 @@ class ClusterController:
                 "hz": round(agg("storage", key + "_hz"), 2),
             }
 
+        # per-endpoint latency-band histograms (FDB's LatencyBands),
+        # summed across every role of a kind (stats.LatencyBands.merge)
+        from ..runtime.stats import LatencyBands
+
+        def band_agg(kind: str, key: str) -> dict:
+            snaps = []
+            for w in workers.values():
+                for snap in (w.get("metrics") or {}).values():
+                    if snap.get("kind") == kind:
+                        snaps.append(snap.get(key))
+            return LatencyBands.merge(snaps)
+
         doc["workload"] = {
             "transactions": {
                 "started": tx("txnStartIn"),
@@ -549,6 +561,12 @@ class ClusterController:
                 "bytes_read": sq("bytesQueried"),
                 "writes": tx("mutations"),
                 "bytes_written": tx("mutationBytes"),
+            },
+            "latency_bands": {
+                "grv": band_agg("proxy", "grvLatencyBands"),
+                "commit": band_agg("proxy", "commitLatencyBands"),
+                "read": band_agg("storage", "readLatencyBands"),
+                "resolve": band_agg("resolver", "resolveLatencyBands"),
             },
         }
         txn_out = agg("proxy", "txnCommitOut")

@@ -51,6 +51,7 @@ from ..runtime.knobs import Knobs
 from ..runtime.loop import now
 from ..runtime.serialize import BinaryWriter, write_mutation
 from ..runtime.stats import CounterCollection
+from ..runtime.trace import emit_span, span, swap_active_span
 from .systemdata import (
     PRIVATE_PREFIX,
     TXS_TAG,
@@ -241,6 +242,10 @@ class Proxy:
         self._c_mutation_bytes = self.stats.counter("mutationBytes")
         self._l_commit = self.stats.latency("commitLatency")
         self._l_grv = self.stats.latency("grvLatency")
+        # per-endpoint latency bands (the reference's GrvProxy/CommitProxy
+        # LatencyBands): exact SLO histograms next to the sampled p50/p95
+        self._b_commit = self.stats.bands("commitLatencyBands")
+        self._b_grv = self.stats.bands("grvLatencyBands")
         # per-phase sim-time samples (batch-cut → reply), for latency work
         self._l_p1 = self.stats.latency("phase1Version")
         self._l_p2 = self.stats.latency("phase2Resolve")
@@ -252,25 +257,36 @@ class Proxy:
         self._check_alive()
         self._c_grv_in.add()
         t0 = now()
-        # ratekeeper gate: new transactions wait for budget when storage
-        # lags (transactionStarter's rate limiting, :925)
-        while self._grv_budget is not None and self._grv_budget < 1.0:
-            await self._grv_replenished.on_trigger()
-            self._check_alive()
-        if self._grv_budget is not None:
-            self._grv_budget -= 1.0
-        # batched: requests that arrived before the master round trip began
-        # share one getLiveCommitted fetch (transactionStarter batching,
-        # MasterProxyServer.actor.cpp:925); arrivals during a flight form
-        # the next batch (RequestBatcher's causality rule).
-        if buggify():
-            await delay(0.001)  # slow GRV (client sees stale-ish versions)
-        if self._grv_batcher is None:
-            self._grv_batcher = RequestBatcher(
-                self._fetch_live_version, self.process.spawn
-            )
-        version = await self._grv_batcher.join()
-        self._l_grv.add(now() - t0)
+        with span("Proxy.grv", self.process.address, proxy=self.uid) as sp:
+            # ratekeeper gate: new transactions wait for budget when storage
+            # lags (transactionStarter's rate limiting, :925)
+            t_gate = now()
+            while self._grv_budget is not None and self._grv_budget < 1.0:
+                await self._grv_replenished.on_trigger()
+                self._check_alive()
+            if self._grv_budget is not None:
+                self._grv_budget -= 1.0
+            if sp.sampled and now() > t_gate:
+                emit_span("Proxy.grvRateGate", self.process.address, sp, t_gate, now())
+            # batched: requests that arrived before the master round trip began
+            # share one getLiveCommitted fetch (transactionStarter batching,
+            # MasterProxyServer.actor.cpp:925); arrivals during a flight form
+            # the next batch (RequestBatcher's causality rule).
+            if buggify():
+                await delay(0.001)  # slow GRV (client sees stale-ish versions)
+            if self._grv_batcher is None:
+                self._grv_batcher = RequestBatcher(
+                    self._fetch_live_version, self.process.spawn
+                )
+            t_confirm = now()
+            version = await self._grv_batcher.join()
+            if sp.sampled:
+                emit_span(
+                    "Proxy.grvConfirm", self.process.address, sp, t_confirm, now()
+                )
+        dt = now() - t0
+        self._l_grv.add(dt)
+        self._b_grv.add(dt)
         return GetReadVersionReply(version=version)
 
     async def _fetch_live_version(self):
@@ -378,15 +394,16 @@ class Proxy:
 
     async def get_key_servers(self, req: GetKeyServersRequest) -> GetKeyServersReply:
         self._check_alive()
-        if buggify():
-            await delay(0.001)  # slow key-location lookup
-        if getattr(req, "before", False):
-            begin, end, team, tags = self.shards.team_before_key(req.key)
-        else:
-            begin, end, team, tags = self.shards.team_for_key(req.key)
-        return GetKeyServersReply(
-            begin=begin, end=end, team=list(team), tags=list(tags)
-        )
+        with span("Proxy.getKeyServers", self.process.address, proxy=self.uid):
+            if buggify():
+                await delay(0.001)  # slow key-location lookup
+            if getattr(req, "before", False):
+                begin, end, team, tags = self.shards.team_before_key(req.key)
+            else:
+                begin, end, team, tags = self.shards.team_for_key(req.key)
+            return GetKeyServersReply(
+                begin=begin, end=end, team=list(team), tags=list(tags)
+            )
 
     # -- commit ----------------------------------------------------------------
 
@@ -397,17 +414,23 @@ class Proxy:
         done: Future = Future()
         self._c_txn_in.add()
         t0 = now()
-        self._batch.append((req.transaction, done))
+        # proxy-residency span (queue wait + batch pipeline); the batch's
+        # stage spans nest under it via the context stored with the entry
+        sp = span("Proxy.commit", self.process.address, proxy=self.uid)
+        self._batch.append((req.transaction, done, sp.context))
         if len(self._batch) == 1:
             self._work._set(None)
         if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
             self._batch_trigger._set(None)
         try:
-            return await done
+            with sp:
+                return await done
         finally:
             # failures (conflict/too-old) are client-observed commit
             # latency too — sample them all
-            self._l_commit.add(now() - t0)
+            dt = now() - t0
+            self._l_commit.add(dt)
+            self._b_commit.add(dt)
 
     async def batcher_loop(self):
         while not self.failed:
@@ -465,7 +488,8 @@ class Proxy:
         )
 
     async def commit_batch(self, batch):
-        replies = [f for _, f in batch]
+        replies = [f for _, f, _ in batch]
+        ctxs = [c for _, _, c in batch if c is not None]
         self._local_batch += 1
         local_n = self._local_batch
         vfut = self._fire_gcv()
@@ -612,11 +636,33 @@ class Proxy:
             pass  # epoch is ending; recovery fences and fills the chain
 
     async def _commit_batch(self, batch, local_n, vfut, vdeadline):
-        txns = [t for t, _ in batch]
-        replies = [f for _, f in batch]
+        txns = [t for t, _, _ in batch]
+        replies = [f for _, f, _ in batch]
+        ctxs = [c for _, _, c in batch if c is not None]
         debug_ids = [
             t.debug_id for t in txns if getattr(t, "debug_id", "")
         ]
+
+        def _stage(name, t0, t1, skip_first=False):
+            # per-stage spans for every sampled txn in the batch: each
+            # sampled commit's waterfall carries the full phase breakdown.
+            # skip_first: the first sampled txn already got a LIVE stage
+            # span (the one parenting the downstream RPCs) — don't
+            # double-attribute its wall time
+            for c in ctxs[1 if skip_first else 0 :]:
+                emit_span(name, self.process.address, c, t0, t1)
+
+        def _live_stage(name):
+            # a live stage span for the first sampled txn: activated
+            # around the downstream RPC sends, so resolver/tlog server
+            # spans nest UNDER the phase that paid for them (exact
+            # critical-path accounting — no parallel-branch double count)
+            return span(
+                name,
+                self.process.address,
+                parent=ctxs[0] if ctxs else None,
+                txns=len(txns),
+            )
 
         def _debug(event):
             # transaction-debug chains (g_traceBatch,
@@ -661,19 +707,30 @@ class Proxy:
             self._apply_resolver_changes(vreq)
             prev_version, version = vreq.prev_version, vreq.version
             _debug("GotCommitVersion")
-            resolve_futs, resolve_meta = self._send_resolve(
-                prev_version, version, txns
-            )
+            # the resolve stage opens HERE (requests fire now, verdicts
+            # collect in phase 2): activating it around the synchronous
+            # send puts its context on every resolve RPC envelope
+            rsp = _live_stage("Proxy.resolve")
+            prev_ctx = swap_active_span(rsp.context)
+            try:
+                resolve_futs, resolve_meta = self._send_resolve(
+                    prev_version, version, txns
+                )
+            finally:
+                swap_active_span(prev_ctx)
         finally:
             # always release the chain — a failed batch must not wedge the
             # proxy; successors fail or succeed on their own
             self._resolving_gate.advance_to(local_n)
         self._l_p1.add(now() - t_p1)
+        _stage("Proxy.getVersion", t_p1, now())
 
         # phase 2: await resolver verdicts
         t_p2 = now()
         resolutions = await wait_for_all(resolve_futs)
         self._l_p2.add(now() - t_p2)
+        rsp.finish()
+        _stage("Proxy.resolve", t_p2, now(), skip_first=True)
         _debug("Resolved")
         verdicts = [Verdict.COMMITTED] * len(txns)
         for idxs, reply in zip(resolve_meta, resolutions):
@@ -683,6 +740,7 @@ class Proxy:
         # phase 3 (ordered): apply forwarded state mutations to the shard
         # map in version order, then tag this batch's mutations with the
         # updated map (commitBatch :414-580)
+        t_p3 = now()
         await self._logging_gate.wait_until(local_n - 1)
         try:
             plan = self._apply_state_mutations(resolutions, version)
@@ -739,20 +797,23 @@ class Proxy:
                     to_log.setdefault(tag, []).append(priv)
         finally:
             self._logging_gate.advance_to(local_n)
+        _stage("Proxy.tag", t_p3, now())
 
         # phase 4: push to the tlog set. Application order is enforced by
         # the tlogs' own prev_version chaining, so pushes of successive
         # batches may be in flight simultaneously (the reference's
         # pipelining).
         t_p4 = now()
-        await self.log_system.push(
-            self.process,
-            prev_version,
-            version,
-            to_log,
-            known_committed=self.committed_version,
-        )
+        with _live_stage("Proxy.logPush"):
+            await self.log_system.push(
+                self.process,
+                prev_version,
+                version,
+                to_log,
+                known_committed=self.committed_version,
+            )
         self._l_p4.add(now() - t_p4)
+        _stage("Proxy.logPush", t_p4, now(), skip_first=True)
         _debug("Logged")
 
         # phase 5: make the commit visible locally, then reply — the

@@ -25,6 +25,7 @@ from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.loop import now
 from ..runtime.stats import CounterCollection
+from ..runtime.trace import emit_span, span
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
 
 
@@ -124,6 +125,9 @@ class Resolver:
         self._c_conflicts = self.stats.counter("conflicts")
         self._c_too_old = self.stats.counter("tooOld")
         self._l_resolve = self.stats.latency("resolveLatency")
+        # per-endpoint latency bands (exact histogram next to the sampled
+        # percentiles; surfaced through resolver.metrics + status)
+        self._b_resolve = self.stats.bands("resolveLatencyBands")
         self.stats.gauge("version", lambda: self.gate.version)
         # device-kernel observability: the TPU/mesh backends carry a
         # KernelMetrics CounterCollection (per-phase wall time, overflow
@@ -147,8 +151,29 @@ class Resolver:
     async def resolve(self, req: ResolveBatchRequest) -> ResolveBatchReply:
         if req.version in self._replies:
             return self._replies[req.version]
+        t_total = now()
+        # resolve span under the proxy's batch span (RPC-envelope parent);
+        # child spans attribute version-chain queueing vs kernel time
+        rsp = span(
+            "Resolver.resolve",
+            self._proc_addr(),
+            resolver=self.uid,
+            txns=len(req.transactions),
+            version=req.version,
+        )
+        try:
+            return await self._resolve_traced(req, rsp, t_total)
+        finally:
+            rsp.finish()
+
+    def _proc_addr(self) -> str:
+        return getattr(self.process, "address", "") if getattr(self, "process", None) else ""
+
+    async def _resolve_traced(self, req, rsp, t_total) -> ResolveBatchReply:
         # ordered application: wait for our turn in the version chain
         await self.gate.wait_until(req.prev_version)
+        if rsp.sampled and now() > t_total:
+            emit_span("Resolver.queue", self._proc_addr(), rsp, t_total, now())
         if req.version in self._replies:  # resolved while waiting (dup)
             return self._replies[req.version]
         if req.prev_version < self.gate.version:
@@ -222,7 +247,21 @@ class Resolver:
             await delay(0)
             try:
                 handle = await dfut
+                if rsp.sampled:
+                    # kernel phases as child spans: dispatch (encode +
+                    # device enqueue) vs collect (verdict readback) — the
+                    # same split KernelMetrics samples in aggregate
+                    emit_span(
+                        "Resolver.kernelDispatch", self._proc_addr(), rsp,
+                        t_resolve, now(), backend=type(self.cs).__name__,
+                    )
+                t_collect = now()
                 verdicts = (await self._submit(handle))[0]
+                if rsp.sampled:
+                    emit_span(
+                        "Resolver.kernelCollect", self._proc_addr(), rsp,
+                        t_collect, now(),
+                    )
                 await self.reply_gate.wait_until(req.prev_version)
             except BaseException as e:
                 # reply_gate must advance even on failure, or retransmit
@@ -235,7 +274,13 @@ class Resolver:
             verdicts = self.cs.detect_batch(
                 txns, now=req.version, new_oldest_version=oldest
             )
+            if rsp.sampled:
+                emit_span(
+                    "Resolver.detect", self._proc_addr(), rsp,
+                    t_resolve, now(), backend=type(self.cs).__name__,
+                )
         self._l_resolve.add(now() - t_resolve)
+        self._b_resolve.add(now() - t_total)
 
         if req.state_txn_indices:
             self._state_txns[req.version] = [
@@ -363,6 +408,7 @@ class Resolver:
         return self.stats.snapshot()
 
     def register(self, process) -> None:
+        self.process = process
         process.register(Tokens.RESOLVE, self.resolve)
         process.register(f"resolver.metrics#{self.uid}", self._metrics)
         process.register(
@@ -371,6 +417,7 @@ class Resolver:
         process.register(f"resolver.splitPoint#{self.uid}", self._split_point)
 
     def register_instance(self, process) -> None:
+        self.process = process
         process.register(f"{Tokens.RESOLVE}#{self.uid}", self.resolve)
         process.register(f"resolver.ping#{self.uid}", self._ping)
         process.register(f"resolver.metrics#{self.uid}", self._metrics)

@@ -28,7 +28,7 @@ from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.loop import now
 from ..runtime.stats import CounterCollection
-from ..runtime.trace import SevInfo, SevWarn, trace
+from ..runtime.trace import SevInfo, SevWarn, emit_span, span, trace
 from ..kv.selector import SELECTOR_END
 from .interfaces import (
     GetKeyReply,
@@ -119,6 +119,9 @@ class StorageServer:
         # client-observed read service time, version wait included (the
         # reference's readLatencyBands) — feeds the status workload section
         self._l_read = self.stats.latency("readLatency")
+        # exact per-endpoint histogram next to the sampled percentiles
+        # (FDB's readLatencyBands proper)
+        self._b_read = self.stats.bands("readLatencyBands")
         self.stats.gauge("version", lambda: self.version.get())
         self.stats.gauge("durableVersion", lambda: self.durable_version)
         self.stats.gauge(
@@ -644,17 +647,34 @@ class StorageServer:
             if state is None or state[0] != "owned" or version < state[1]:
                 raise WrongShardServer()
 
+    def _proc_addr(self) -> str:
+        return getattr(self.process, "address", "") if self.process else ""
+
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
         t0 = now()
-        if buggify():
-            await delay(0.001)  # slow replica (hedging/load-balance paths)
-        await self._wait_for_version(req.version)
-        self._check_read(req.key, req.key + b"\x00", req.version)
-        known, value = self.data.get_with_presence(req.key, req.version)
-        if not known and self.engine is not None:
-            value = self.engine.read_value(req.key)
+        with span(
+            "Storage.getValue", self._proc_addr(), storage=self.uid
+        ) as sp:
+            if buggify():
+                await delay(0.001)  # slow replica (hedging/load-balance paths)
+            t_wait = now()
+            await self._wait_for_version(req.version)
+            if sp.sampled and now() > t_wait:
+                emit_span(
+                    "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
+                )
+            self._check_read(req.key, req.key + b"\x00", req.version)
+            t_eng = now()
+            known, value = self.data.get_with_presence(req.key, req.version)
+            if not known and self.engine is not None:
+                value = self.engine.read_value(req.key)
+            if sp.sampled:
+                emit_span("Storage.engine", self._proc_addr(), sp, t_eng, now())
+                sp.event("StorageRead", kind="ReadDebug")
+        dt = now() - t0
         self._c_queries.add()
-        self._l_read.add(now() - t0)
+        self._l_read.add(dt)
+        self._b_read.add(dt)
         if value is not None:
             self._c_rows.add()
             self._c_bytes_q.add(len(req.key) + len(value))
@@ -662,16 +682,33 @@ class StorageServer:
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         t0 = now()
-        await self._wait_for_version(req.version)
-        self._check_read(req.begin, req.end, req.version)
-        # tiny replies force every caller through its `more`/windowing path
-        limit = 1 if buggify() else req.limit
-        data = self._read_range_merged(
-            req.begin, req.end, req.version, limit + 1, req.reverse
-        )
+        with span(
+            "Storage.getRange", self._proc_addr(), storage=self.uid
+        ) as sp:
+            t_wait = now()
+            await self._wait_for_version(req.version)
+            if sp.sampled and now() > t_wait:
+                emit_span(
+                    "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
+                )
+            self._check_read(req.begin, req.end, req.version)
+            # tiny replies force every caller through its `more`/windowing path
+            limit = 1 if buggify() else req.limit
+            t_eng = now()
+            data = self._read_range_merged(
+                req.begin, req.end, req.version, limit + 1, req.reverse
+            )
+            if sp.sampled:
+                emit_span(
+                    "Storage.engine", self._proc_addr(), sp, t_eng, now(),
+                    rows=len(data),
+                )
+                sp.event("StorageRead", kind="ReadDebug")
         more = len(data) > limit
+        dt = now() - t0
         self._c_queries.add()
-        self._l_read.add(now() - t0)
+        self._l_read.add(dt)
+        self._b_read.add(dt)
         self._c_rows.add(min(len(data), limit))
         self._c_bytes_q.add(sum(len(k) + len(v) for k, v in data[:limit]))
         return GetKeyValuesReply(data=data[:limit], more=more)
@@ -690,6 +727,16 @@ class StorageServer:
         return b, e
 
     async def get_key(self, req: GetKeyRequest) -> GetKeyReply:
+        t0 = now()
+        with span("Storage.getKey", self._proc_addr(), storage=self.uid) as sp:
+            try:
+                return await self._get_key_impl(req, sp)
+            finally:
+                dt = now() - t0
+                self._l_read.add(dt)
+                self._b_read.add(dt)
+
+    async def _get_key_impl(self, req: GetKeyRequest, sp) -> GetKeyReply:
         """Resolve a normalized key selector within this shard (getKeyQ,
         storageserver.actor.cpp:1288): walk ``offset`` keys forward from
         the anchor (or ``1 - offset`` backward), clamped to the shard —
@@ -700,7 +747,10 @@ class StorageServer:
         before-begin to b"" (the reference's non-system clamps)."""
         if buggify():
             await delay(0.001)  # slow replica (hedging/load-balance paths)
+        t_wait = now()
         await self._wait_for_version(req.version)
+        if sp.sampled and now() > t_wait:
+            emit_span("Storage.waitVersion", self._proc_addr(), sp, t_wait, now())
         k, off = req.key, req.offset
         self._c_queries.add()
         before = off < 1
@@ -842,6 +892,16 @@ class StorageServer:
         vectorized lookup (SURVEY.md's batched read-path primitive).
         req = (keys, version) → [value | None]."""
         keys, version = req
+        t0 = now()
+        with span(
+            "Storage.batchGet", self._proc_addr(), storage=self.uid, keys=len(keys)
+        ):
+            out = await self._batch_get_impl(keys, version)
+        dt = now() - t0
+        self._b_read.add(dt)
+        return out
+
+    async def _batch_get_impl(self, keys, version):
         await self._wait_for_version(version)
         out = [None] * len(keys)
         misses, miss_idx = [], []

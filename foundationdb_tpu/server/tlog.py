@@ -26,6 +26,8 @@ from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.stats import CounterCollection
+from ..runtime.loop import now
+from ..runtime.trace import emit_span, span
 from .systemdata import TXS_TAG
 from .interfaces import (
     TLogCommitRequest,
@@ -166,8 +168,23 @@ class TLog:
     async def commit(self, req: TLogCommitRequest):
         if self.stopped:
             raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
-        # version-ordered application (same chain discipline as the resolver)
-        await self._gate.wait_until(req.prev_version)
+        # push span under the proxy's batch span (RPC-envelope parent);
+        # the queue child separates version-chain waiting from fsync time
+        t0 = now()
+        tsp = span("TLog.push", self._proc_addr(), log=self.log_id, version=req.version)
+        try:
+            # version-ordered application (same chain discipline as the resolver)
+            await self._gate.wait_until(req.prev_version)
+            if tsp.sampled and now() > t0:
+                emit_span("TLog.queue", self._proc_addr(), tsp, t0, now())
+            return await self._commit_inner(req)
+        finally:
+            tsp.finish()
+
+    def _proc_addr(self) -> str:
+        return getattr(getattr(self, "process", None), "address", "") or f"tlog:{self.log_id}"
+
+    async def _commit_inner(self, req: TLogCommitRequest):
         if self.stopped:
             # fenced while waiting: must not make this durable/acked — the
             # recovery already chose an end version without it
@@ -470,6 +487,7 @@ class TLog:
 
     def register_instance(self, process) -> None:
         """Id-suffixed tokens: many generations can share a worker."""
+        self.process = process
         process.register(f"tlog.commit#{self.log_id}", self.commit)
         process.register(f"tlog.peek#{self.log_id}", self.peek)
         process.register(f"tlog.pop#{self.log_id}", self.pop)

@@ -130,6 +130,18 @@ def snapshot(result, platform):
                 k.get("deviceToHostBytes"),
             )
         )
+    # span-layer stage attribution (perf --trace-sample embeds it): the
+    # read/commit critical-path breakdown rides the BENCH JSON next to the
+    # kernel snapshot, so a capture says WHERE its milliseconds went
+    for root, agg in sorted((entry.get("trace_breakdown") or {}).items()):
+        top = ", ".join(
+            "%s=%sms" % (s.get("stage"), s.get("mean_ms"))
+            for s in (agg.get("stages") or [])[:4]
+        )
+        log(
+            "stages[%s]: p50=%sms over %s traces  %s"
+            % (root, agg.get("p50_ms"), agg.get("traces"), top)
+        )
 
 
 _EVIDENCE_DONE = False

@@ -133,6 +133,22 @@ class FdbCli:
                 f"{hz('committed'):.0f} committed/s, "
                 f"{hz('conflicted'):.0f} conflicted/s"
             )
+        bands = wl.get("latency_bands") or {}
+        for leg in ("grv", "read", "commit"):
+            b = bands.get(leg) or {}
+            if b.get("count"):
+                parts = [
+                    f"<= {edge}s: {n}"
+                    for edge, n in sorted(
+                        (b.get("bands") or {}).items(),
+                        key=lambda kv: float("inf") if kv[0] == "inf" else float(kv[0]),
+                    )
+                    if n
+                ]
+                lines.append(
+                    f"Latency bands [{leg}] ({b['count']} reqs): "
+                    + ", ".join(parts)
+                )
         qos = doc.get("qos") or {}
         if qos:
             rate = qos.get("released_transactions_per_second")
@@ -200,6 +216,43 @@ class FdbCli:
                         f"{snap.get('transactions', 0)} txns, "
                         f"{snap.get('conflicts', 0)} conflicts{extra}"
                     )
+        return "\n".join(lines)
+
+    async def _cmd_trace(self, args) -> str:
+        """trace                      — list sampled traces
+        trace <trace-id>          — waterfall for one trace
+        trace breakdown           — aggregate critical-path breakdown
+        Any argument naming an existing file is loaded as a JSONL trace
+        file (per-process files merge; rolled siblings included); with no
+        files, this process's in-memory TraceLog serves (the sim case,
+        where every role shares it)."""
+        import os as _os
+
+        from ..runtime.trace import trace_log
+        from . import trace_analyze as ta
+
+        files = [a for a in args if _os.path.exists(a) or a.endswith(".jsonl")]
+        sel = [a for a in args if a not in files]
+        events = ta.load_events(files) if files else trace_log().events
+        if sel and sel[0] == "breakdown":
+            return ta.format_critical_path(ta.critical_path(events))
+        if sel:
+            return ta.format_waterfall(events, sel[0])
+        traces = ta.spans_by_trace(events)
+        if not traces:
+            return "no sampled traces (set TRACE_SAMPLE_RATE or a debug id)"
+        lines = [f"{len(traces)} sampled traces:"]
+        for tid, spans in sorted(traces.items())[:25]:
+            t0 = min(s.get("Begin") or 0.0 for s in spans)
+            t1 = max((s.get("Begin") or 0.0) + (s.get("Dur") or 0.0) for s in spans)
+            names = ",".join(
+                sorted({r.get("Name", "?") for r in ta._roots(spans)})
+            )
+            lines.append(
+                f"  {tid}: {len(spans)} spans, {(t1 - t0) * 1000:.3f} ms  [{names}]"
+            )
+        if len(traces) > 25:
+            lines.append(f"  ... and {len(traces) - 25} more")
         return "\n".join(lines)
 
     async def _cmd_exclude(self, args) -> str:

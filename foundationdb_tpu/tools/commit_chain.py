@@ -16,7 +16,13 @@ from __future__ import annotations
 
 from ..runtime.trace import trace_log
 
-STAGE_ORDER = [
+# Stage vocabulary, unified with the span layer (runtime/trace.py): the
+# commit stages are emitted through Span.event (Type=CommitDebug — the
+# historical stream, byte-stable for existing consumers) and the read/GRV
+# stages through the same API with Type=ReadDebug, so read chains never
+# leak into commit-only chains. Order = pipeline order, used to break
+# same-time ties.
+COMMIT_STAGES = [
     "ClientCommitStart",
     "ProxyReceived",
     "GotCommitVersion",
@@ -26,20 +32,40 @@ STAGE_ORDER = [
     "Replied",
     "ClientCommitDone",
 ]
+READ_STAGES = [
+    "ClientGRVStart",
+    "ClientGRVDone",
+    "ClientReadStart",
+    "StorageRead",
+    "ClientReadRetry",
+    "ClientReadDone",
+]
+STAGE_ORDER = COMMIT_STAGES + READ_STAGES
+
+# event Types that carry chain stages; chain() reads only the commit
+# stream by default (output stability), full_chain() reads both
+CHAIN_TYPES = ("CommitDebug", "ReadDebug")
 
 
-def chain(debug_id: str, events: list = None) -> list[dict]:
-    """Time-ordered CommitDebug events for one id (ties broken by
-    pipeline stage order)."""
+def chain(debug_id: str, events: list = None, types=("CommitDebug",)) -> list[dict]:
+    """Time-ordered debug events for one id (ties broken by pipeline
+    stage order). Default: the CommitDebug stream only — exactly the
+    historical output; pass ``types=CHAIN_TYPES`` (or use full_chain) to
+    include the read-path stages."""
     evs = events if events is not None else trace_log().events
     rank = {s: i for i, s in enumerate(STAGE_ORDER)}
     out = [
         e
         for e in evs
-        if e.get("Type") == "CommitDebug" and e.get("Id") == debug_id
+        if e.get("Type") in types and e.get("Id") == debug_id
     ]
     out.sort(key=lambda e: (e["Time"], rank.get(e.get("Event"), 99)))
     return out
+
+
+def full_chain(debug_id: str, events: list = None) -> list[dict]:
+    """Commit AND read/GRV stages for one id, time-ordered."""
+    return chain(debug_id, events, types=CHAIN_TYPES)
 
 
 def format_chain(debug_id: str, events: list = None) -> str:

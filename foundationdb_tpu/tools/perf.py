@@ -57,12 +57,19 @@ def run_sim(args) -> dict:
     from ..server import Cluster, ClusterConfig
     from ..workloads import run_workloads
 
+    from ..runtime.trace import TraceLog, set_trace_log, trace_log
+
     sim = Sim(seed=args.seed)
     sim.activate()
     # benchmark network profile (bench.py's e2e rationale): the published
     # numbers come from real clusters with ~0.1-0.25 ms hops
     sim.knobs.SIM_FAST_LATENCY = 0.00025
     sim.knobs.SIM_MAX_LATENCY = 0.001
+    if args.trace_sample > 0:
+        # span tracing for stage attribution: a fresh TraceLog so the
+        # breakdown covers exactly this run
+        sim.knobs.TRACE_SAMPLE_RATE = args.trace_sample
+        set_trace_log(TraceLog())
     cluster = Cluster(
         sim,
         ClusterConfig(
@@ -77,7 +84,17 @@ def run_sim(args) -> dict:
         return True
 
     sim.run_until_done(spawn(go()), 36000.0)
-    return w.rec.report()
+    report = w.rec.report()
+    if args.trace_sample > 0:
+        # aggregate read/commit critical-path breakdown (sim-time ms),
+        # embedded next to the throughput numbers so BENCH JSONs carry
+        # stage attribution (tools/trace_analyze span mode)
+        from .trace_analyze import critical_path
+
+        report["trace_breakdown"] = critical_path(
+            trace_log().events, root_prefix="Client."
+        )
+    return report
 
 
 def make_workload(args, db, rng, now_fn=None):
@@ -235,6 +252,11 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=0.0,
                     help="> 0: time-bounded ThroughputWorkload")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--trace-sample", type=float, default=0.0, dest="trace_sample",
+        help="> 0: sample this fraction of txns into spans and embed the "
+             "read/commit critical-path breakdown in the report (sim mode)",
+    )
     ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
     ap.add_argument("--client-id", type=int, default=0, dest="client_id")
     ap.add_argument("--coordinators", default=None)

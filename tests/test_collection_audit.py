@@ -94,6 +94,67 @@ def test_every_server_role_registers_metrics():
         )
 
 
+def test_rpc_endpoints_open_spans_or_are_allowlisted():
+    """Span-coverage lint: every RPC endpoint a proxy/storage/resolver
+    registers must either open a distributed-trace span (runtime/trace.py
+    ``span(``) in its handler, or sit on the explicit allowlist below —
+    so a new client-facing endpoint can't ship invisible to the read/
+    commit waterfalls the perf PRs cite."""
+    import inspect
+    import re
+
+    from foundationdb_tpu.server.proxy import Proxy
+    from foundationdb_tpu.server.resolver import Resolver
+    from foundationdb_tpu.server.storage import StorageServer
+
+    # admin/metrics/liveness endpoints (no client-visible latency to
+    # attribute) and long-polls (a span covering a parked watch would
+    # report minutes of "latency"): exempt BY NAME, never by default
+    ALLOW = {
+        "proxy": {"_ping", "_metrics", "_raw_committed"},
+        "resolver": {"_ping", "_metrics", "_resolution_metrics", "_split_point"},
+        "storage": {
+            "_ping",
+            "_metrics",
+            "_get_version",
+            "_owned_ranges",
+            "get_shard_state",
+            "get_shard_metrics",
+            "get_split_key",
+            "watch_value",  # long-poll: parks until the value changes
+        },
+    }
+
+    for kind, cls in (
+        ("proxy", Proxy),
+        ("resolver", Resolver),
+        ("storage", StorageServer),
+    ):
+        handlers = set()
+        for meth in ("register", "register_instance", "register_endpoints"):
+            fn = getattr(cls, meth, None)
+            if fn is None:
+                continue
+            handlers |= set(
+                re.findall(
+                    r"process\.register\([^,]+,\s*self\.(\w+)\)",
+                    inspect.getsource(fn),
+                )
+            )
+        assert handlers, f"{kind}: no registered endpoints found by the lint"
+        missing = []
+        for h in sorted(handlers):
+            if h in ALLOW[kind]:
+                continue
+            if "span(" not in inspect.getsource(getattr(cls, h)):
+                missing.append(h)
+        assert not missing, (
+            f"{kind}: endpoints with neither a span nor an allowlist "
+            f"entry: {missing} — open a span (runtime/trace.py) or add an "
+            f"explicit exemption here"
+        )
+
+
 def test_acceptance_batteries_not_slow_marked():
     for name in TIER1_PINNED:
         path = TESTS / name
