@@ -21,6 +21,7 @@ from ..errors import FdbError, NotCommitted, TransactionTooOld
 from ..kv.atomic import apply_atomic
 from ..kv.mutations import MutationType
 from ..kv.selector import SELECTOR_END, KeySelector, as_selector
+from ..runtime.loop import Cancelled
 
 
 class ModelDatabase:
@@ -45,6 +46,8 @@ class ModelDatabase:
                 result = await body(tr)
                 await tr.commit()
                 return result
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 await tr.on_error(e)
 

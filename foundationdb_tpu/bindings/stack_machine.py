@@ -33,6 +33,7 @@ from ..kv.selector import KeySelector
 from ..layers import tuple as T
 from ..net.sim import BrokenPromise
 from ..client.transaction import strinc as _strinc
+from ..runtime.loop import Cancelled
 
 ERROR_CODES = {
     "NotCommitted": b"1020",
@@ -187,6 +188,8 @@ class StackMachine:
         try:
             await self._tr().on_error(err)
             self.push(inum, RESULT_NOT_PRESENT)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception as e:
             self.push(inum, _error_tuple(e))
 

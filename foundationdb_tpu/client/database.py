@@ -39,6 +39,7 @@ from ..server.interfaces import (
 )
 from .loadbalance import QueueModel
 from .transaction import Transaction
+from ..runtime.loop import Cancelled
 
 # distinct from None: a cleared key's baseline value IS None
 _NO_VALUE = object()
@@ -267,6 +268,8 @@ class Database:
                 return
             except (FdbError, BrokenPromise):
                 await delay(0.1)
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 if not out.is_ready():
                     out._set_error(e)

@@ -13,6 +13,7 @@ from ..net.sim import Endpoint
 from ..runtime.futures import delay
 from ..server.interfaces import Tokens
 from ..server.systemdata import CONF_PREFIX
+from ..runtime.loop import Cancelled
 
 EXCLUDED_PREFIX = CONF_PREFIX + b"excluded/"
 
@@ -131,6 +132,8 @@ async def _leader_request(
                 # the stale-leader case must fall through to rediscovery
                 if reply is not _TIMED_OUT and accept(reply):
                     return reply
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass
             await delay(0.5)

@@ -26,6 +26,11 @@ same class, so the driver's multi-chip validation exercises exactly the
 cluster's code path.
 """
 
+# flowlint: disable-file=det-wall-clock — KernelMetrics phase timings
+# measure HOST wall time of device work (encode/dispatch/collect/reshard)
+# on purpose; they are evidence counters, never inputs to sim scheduling
+# (same-seed replay is unaffected: no control flow reads them).
+
 from __future__ import annotations
 
 import functools

@@ -18,6 +18,11 @@ deterministic simulation twin the test suite uses (SURVEY.md §4: TPU
 kernels must have a sim-mode CPU twin).
 """
 
+# flowlint: disable-file=det-wall-clock — KernelMetrics phase timings
+# measure HOST wall time of device work (encode/dispatch/collect/reshard)
+# on purpose; they are evidence counters, never inputs to sim scheduling
+# (same-seed replay is unaffected: no control flow reads them).
+
 from __future__ import annotations
 
 import time

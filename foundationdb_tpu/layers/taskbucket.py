@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import json
 
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from .subspace import Subspace
 
 
@@ -117,6 +117,8 @@ async def run_agent(db, bucket: TaskBucket, handlers: dict, stop) -> None:
         try:
             await handler(db, task["params"])
             await bucket.finish(db, tid)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             # leave claimed: the lease expiry re-queues it for retry
             await delay(0.5)

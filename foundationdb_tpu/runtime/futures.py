@@ -417,6 +417,14 @@ class RequestBatcher:
                 waiters, self._waiters = self._waiters, []
                 try:
                     value = await self._fetch()
+                except Cancelled:
+                    # actor-cancelled-swallow: the batcher dies with its
+                    # cancellation, but parked callers must not hang on a
+                    # fetch that will never be retried
+                    for w in waiters:
+                        if not w.is_ready():
+                            w._set_error(Cancelled())
+                    raise
                 except BaseException as e:
                     for w in waiters:
                         if not w.is_ready():

@@ -100,7 +100,9 @@ class RealLoop(EventLoop):
         from collections import deque
 
         if seed is None:
-            seed = int.from_bytes(_os.urandom(8), "little")
+            # the REAL personality seeds from OS entropy by design: there is
+            # no replay to protect, and distinct processes must diverge
+            seed = int.from_bytes(_os.urandom(8), "little")  # flowlint: disable=det-entropy
         super().__init__(seed)
         self._selector = selectors.DefaultSelector()
         self._t0 = self._monotonic()
@@ -167,7 +169,9 @@ class RealLoop(EventLoop):
     def _monotonic() -> float:
         import time as _time
 
-        return _time.monotonic()
+        # the ONE place wall time enters the system: RealLoop IS the
+        # wall-clock personality; everything above it sees loop.now()
+        return _time.monotonic()  # flowlint: disable=det-wall-clock
 
     def _wall(self) -> float:
         return self._monotonic() - self._t0

@@ -17,7 +17,7 @@ from ..net.sim import Endpoint
 from ..runtime.futures import AsyncVar, delay, timeout
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .interfaces import (
@@ -184,6 +184,8 @@ class ClusterController:
                     ),
                     2.0,
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 await delay(self.knobs.HEARTBEAT_INTERVAL)
                 continue
@@ -208,6 +210,8 @@ class ClusterController:
                         self.knobs.HEARTBEAT_INTERVAL * 3,
                     )
                     misses = 0 if r is not None else misses + 1
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     misses += 1
             trace(SevWarn, "MasterFailed", self.process.address, Uid=uid)
@@ -245,6 +249,8 @@ class ClusterController:
                     ),
                     1.0,
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass
 
@@ -297,6 +303,8 @@ class ClusterController:
                 ),
                 2.0,
             )
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             pass
         trace(SevInfo, "ForcedRecovery", self.process.address, Master=uid)
@@ -394,6 +402,8 @@ class ClusterController:
                     raise TimeoutError("commit probe timed out")
                 latest["commit_seconds"] = round(now() - t0, 6)
                 self._l_probe_commit.add(now() - t0)
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 self._c_probe_err.add()
                 trace(
@@ -452,6 +462,8 @@ class ClusterController:
                 return await timeout(
                     self.process.request(Endpoint(address, token), None), 1.0
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 return None
 
@@ -600,6 +612,8 @@ class ClusterController:
                 )
                 if rate is not None:
                     doc["qos"]["released_transactions_per_second"] = rate
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass
 

@@ -33,7 +33,7 @@ from ..runtime.futures import (
     quorum,
     wait_for_any,
 )
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 
@@ -480,6 +480,8 @@ async def try_become_leader(
         vote, not a lost election)."""
         try:
             return await fut
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             return None
 
@@ -563,6 +565,8 @@ class Leadership:
             for f in futs:
                 try:
                     still_nominee = await f
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     continue
                 if still_nominee:
@@ -596,6 +600,8 @@ async def monitor_leader(
         for f in futs:
             try:
                 reply = await timeoutish(f, POLL_DELAY * 2)
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue
             if reply is not None and reply.nominee is not None:

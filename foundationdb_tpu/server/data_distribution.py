@@ -29,6 +29,7 @@ from ..runtime.trace import SevInfo, SevWarn, trace
 from ..runtime.buggify import buggify
 from .interfaces import GetKeyServersRequest, Tokens
 from .movekeys import merge_shards, move_shard, split_shard, take_move_keys_lock
+from ..runtime.loop import Cancelled
 
 
 class DataDistributor:
@@ -69,6 +70,8 @@ class DataDistributor:
                 await delay(0.2 if buggify() else 1.0)  # eager repair races moves
                 try:
                     await self._repair_once()
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception as e:
                     trace(
                         SevWarn, "DDRepairError", self.process.address, Err=repr(e)
@@ -89,6 +92,8 @@ class DataDistributor:
                         self.knobs.HEARTBEAT_INTERVAL * 2,
                     )
                     ok = r is not None
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     ok = False
                 misses[s.tag] = 0 if ok else misses[s.tag] + 1
@@ -122,6 +127,8 @@ class DataDistributor:
                         ),
                         1.0,
                     )
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     ready = None
                 if ready:
@@ -148,6 +155,8 @@ class DataDistributor:
 
         try:
             return await self.db.run(body, max_retries=3)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             return set()
 
@@ -161,6 +170,8 @@ class DataDistributor:
             await delay(self.knobs.DD_TRACKER_INTERVAL)
             try:
                 await self._track_once()
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 trace(
                     SevWarn, "DDTrackerError", self.process.address, Err=repr(e)
@@ -178,6 +189,8 @@ class DataDistributor:
                     ),
                     1.0,
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue
             if m is not None:
@@ -210,6 +223,8 @@ class DataDistributor:
                         ),
                         1.0,
                     )
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     continue
                 break
@@ -371,6 +386,8 @@ class Ratekeeper:
             for s in self.storage:
                 try:
                     r = await timeout(self.process.request(s.ep("version"), None), 0.5)
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     continue
                 if r is not None:

@@ -38,6 +38,7 @@ from ..runtime.futures import (
     wait_for_all,
     wait_for_any,
 )
+from ..runtime.loop import Cancelled
 
 
 @dataclass(frozen=True)
@@ -352,5 +353,7 @@ class PeekCursor:
         for f in futs:
             try:
                 await f
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass  # popping a dead tlog is moot

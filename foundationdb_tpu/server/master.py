@@ -34,7 +34,7 @@ from dataclasses import dataclass, field
 
 from ..kv.keyrange_map import KeyRangeMap
 from ..runtime.futures import delay, wait_for_all
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.buggify import buggify
 from ..runtime.trace import SevInfo, SevWarn, trace
 from .coordination import ClusterStateChanged, CoordinatedState
@@ -245,6 +245,8 @@ async def _router_frontier(process, router_set: TLogSet) -> int:
                 )
                 versions.append(int(v))
             return min(versions)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             await delay(0.5)
     # a surviving-region router is permanently gone: die so the CC
@@ -403,6 +405,8 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
                 reply = await process.request(
                     log.ep("peek"), TLogPeekRequest(tag=TXS_TAG, begin=1)
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue
             from .systemdata import apply_log_range_mutations
@@ -862,6 +866,8 @@ class _RolePicker:
 async def _pop_quietly(process, ep, req):
     try:
         await process.request(ep, req)
+    except Cancelled:
+        raise  # actor-cancelled-swallow
     except Exception:
         pass  # popping a dead tlog is moot
 
@@ -972,6 +978,8 @@ async def _wait_failure(process, watched, interval=0.3, misses_allowed=4):
                 if r is None:
                     raise BrokenPromise("ping timeout")
                 misses[key] = 0
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 misses[key] += 1
                 if misses[key] >= misses_allowed:

@@ -30,6 +30,7 @@ from .systemdata import (
     key_servers_key,
     key_servers_value,
 )
+from ..runtime.loop import Cancelled
 
 
 class MoveKeysError(Exception):
@@ -161,6 +162,8 @@ async def move_shard(
                 )
                 if ready:
                     break
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass
             if now() > deadline:

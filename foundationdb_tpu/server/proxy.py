@@ -48,7 +48,7 @@ from ..runtime.futures import (
     wait_for_any,
 )
 from ..runtime.knobs import Knobs
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.serialize import BinaryWriter, write_mutation
 from ..runtime.stats import CounterCollection
 from ..runtime.trace import emit_span, span, swap_active_span
@@ -150,6 +150,8 @@ async def _swallow(fut):
     commit, which is already durable)."""
     try:
         await fut
+    except Cancelled:
+        raise  # actor-cancelled-swallow
     except Exception:
         pass
 
@@ -377,6 +379,8 @@ class Proxy:
             await delay(interval)
             try:
                 rate = await self.process.request(self.master.ep("getRate"), None)
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 rate = None
             if rate is None:
@@ -595,6 +599,8 @@ class Proxy:
         never arrives, no version was assigned and there is no hole."""
         try:
             vreq = await vfut
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             return  # request truly lost: the master assigned nothing
         # a late grant can be the carrier of a balancing change set —
@@ -632,6 +638,8 @@ class Proxy:
                 {},
                 known_committed=self.committed_version,
             )
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             pass  # epoch is ending; recovery fences and fills the chain
 
@@ -1012,10 +1020,10 @@ class Proxy:
         self.failed = True
         self._grv_replenished.trigger()
 
-    async def _metrics(self, _req) -> dict:
+    async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         return self.stats.snapshot()
 
-    async def _raw_committed(self, _req) -> Version:
+    async def _raw_committed(self, _req) -> Version:  # flowlint: disable=reg-endpoint-span — admin/recovery
         """getRawCommittedVersion (MasterProxyServer.actor.cpp:1214): the
         peer-confirmation half of getLiveCommittedVersion."""
         self._check_alive()
@@ -1042,7 +1050,7 @@ class Proxy:
         process.register(f"proxy.metrics#{self.uid}", self._metrics)
         process.register(f"proxy.rawCommitted#{self.uid}", self._raw_committed)
 
-    async def _ping(self, _req):
+    async def _ping(self, _req):  # flowlint: disable=reg-endpoint-span — liveness
         self._check_alive()
         return "pong"
 

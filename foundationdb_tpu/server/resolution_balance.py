@@ -23,6 +23,7 @@ from __future__ import annotations
 from ..kv.keyrange_map import KeyRangeMap
 from ..net.sim import Endpoint
 from ..runtime.futures import delay, wait_for_all
+from ..runtime.loop import Cancelled
 
 
 class ResolutionBalancer:
@@ -162,6 +163,8 @@ class ResolutionBalancer:
             try:
                 await self.step(process)
                 failures = 0
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 # a resolver mid-restart is survivable (recovery replaces
                 # this balancer with the epoch), but PERSISTENT failure

@@ -372,12 +372,12 @@ class Resolver:
                 decayed = {k: decayed[k] for k in keep}
             self._load_sample = decayed
 
-    async def _resolution_metrics(self, _req) -> dict:
+    async def _resolution_metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         """Cumulative conflict-range op count (the master's balancer diffs
         between polls — ResolutionMetricsRequest)."""
         return {"ops": self._load_ops, "version": self.gate.version}
 
-    async def _split_point(self, req: dict) -> dict:
+    async def _split_point(self, req: dict) -> dict:  # flowlint: disable=reg-endpoint-span — admin/balance
         """Find a key carving ~target_ops of sampled load off one end of
         [begin, end) (ResolutionSplitRequest: front=True carves a prefix,
         else a suffix). Returns {'key': split_key, 'ops': carved}."""
@@ -404,7 +404,7 @@ class Resolver:
         # no split inside the segment; the caller rejects key <= begin
         return {"key": keys[0], "ops": acc}
 
-    async def _metrics(self, _req) -> dict:
+    async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         return self.stats.snapshot()
 
     def register(self, process) -> None:
@@ -426,5 +426,5 @@ class Resolver:
         )
         process.register(f"resolver.splitPoint#{self.uid}", self._split_point)
 
-    async def _ping(self, _req):
+    async def _ping(self, _req):  # flowlint: disable=reg-endpoint-span — liveness
         return "pong"

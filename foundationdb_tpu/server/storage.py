@@ -26,7 +26,7 @@ from ..kv.versioned_map import VersionedMap
 from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.stats import CounterCollection
 from ..runtime.trace import SevInfo, SevWarn, emit_span, span, trace
 from ..kv.selector import SELECTOR_END
@@ -417,6 +417,8 @@ class StorageServer:
                 src_i += 1
                 await delay(0.1)
                 continue
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 src_i += 1
                 await delay(0.1)
@@ -924,7 +926,7 @@ class StorageServer:
                     out[i] = self.engine.read_value(misses[j])
         return out
 
-    async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:
+    async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:  # flowlint: disable=reg-endpoint-span — long-poll: a span over a parked watch would read as minutes of latency
         """Park until the key's value differs from the watcher's belief
         (watchValue_impl:758). Fires on the version that changed it. The
         shard moving away surfaces as wrong_shard_server and the client
@@ -965,7 +967,7 @@ class StorageServer:
         keys = ks[lo:hi:stride]
         return keys, stride, (lambda k: len(k) + len(self.engine._map.get(k, b"")))
 
-    async def get_shard_metrics(self, req) -> dict:
+    async def get_shard_metrics(self, req) -> dict:  # flowlint: disable=reg-endpoint-span — admin/DD
         """Estimated bytes/rows for [begin, end) — the DD tracker's
         getShardMetrics source (DataDistributionTracker.actor.cpp:829)."""
         begin, end = req
@@ -974,7 +976,7 @@ class StorageServer:
         est = sum(size_of(k) for k in keys) * stride
         return {"bytes": est, "rows": len(keys) * stride}
 
-    async def get_split_key(self, req):
+    async def get_split_key(self, req):  # flowlint: disable=reg-endpoint-span — admin/DD
         """A key splitting [begin, end) into roughly equal halves by
         sampled bytes (splitStorageMetrics analog); None when the range
         is too small to split."""
@@ -991,7 +993,7 @@ class StorageServer:
                 return k if begin < k < end else None
         return None
 
-    async def get_shard_state(self, req) -> bool:
+    async def get_shard_state(self, req) -> bool:  # flowlint: disable=reg-endpoint-span — admin/DD
         """Is [begin, end) fully owned and readable? (the mover's readiness
         poll before finishMoveKeys — getShardStateQ in the reference)."""
         begin, end = req
@@ -1004,7 +1006,7 @@ class StorageServer:
 
     # -- wiring ----------------------------------------------------------------
 
-    async def _get_version(self, _req):
+    async def _get_version(self, _req):  # flowlint: disable=reg-endpoint-span — liveness/lag poll
         """(version, durable_version, followed_epoch). The epoch qualifies
         the version — a raw version may still include a pre-recovery tail
         this server has not rolled back yet (it only rolls back once it
@@ -1012,7 +1014,7 @@ class StorageServer:
         would come back with (old tlog generations must outlive it)."""
         return (self.version.get(), self.durable_version, self._followed_epoch)
 
-    async def _owned_ranges(self, _req) -> list:
+    async def _owned_ranges(self, _req) -> list:  # flowlint: disable=reg-endpoint-span — admin
         """[(begin, end)] this server currently OWNS — its applied view of
         the shard map. The failover promotion rebuilds the cluster shard
         map from the mirrors' own state (the coordinated snapshot may
@@ -1023,7 +1025,7 @@ class StorageServer:
             if state is not None and state[0] == "owned"
         ]
 
-    async def _metrics(self, _req) -> dict:
+    async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         return self.stats.snapshot()
 
     def register_endpoints(self, process) -> None:
@@ -1061,5 +1063,5 @@ class StorageServer:
             a.cancel()
             b.cancel()
 
-    async def _ping(self, _req):
+    async def _ping(self, _req):  # flowlint: disable=reg-endpoint-span — liveness
         return "pong"

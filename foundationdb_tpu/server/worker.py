@@ -32,6 +32,7 @@ from .interfaces import (
     SetDBInfoRequest,
     Tokens,
 )
+from ..runtime.loop import Cancelled
 
 
 @dataclass
@@ -99,6 +100,8 @@ class Worker:
             f = self.disk.open(name)
             try:
                 m = json.loads((await f.read(0, f.size())).decode())
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue
             if m["uid"] in self.roles:
@@ -193,6 +196,8 @@ class Worker:
                             CC=leader.address,
                             Class=self.process_class,
                         )
+                except Cancelled:
+                    raise  # actor-cancelled-swallow
                 except Exception:
                     pass
             await delay(
@@ -565,6 +570,8 @@ class Worker:
                     cc_address,
                     initial_config or self.initial_config,
                 )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 trace(
                     SevWarn,

@@ -12,6 +12,7 @@ import json
 import shlex
 
 from ..client import management
+from ..runtime.loop import Cancelled
 
 
 class FdbCli:
@@ -29,6 +30,8 @@ class FdbCli:
             return f"ERROR: unknown command `{cmd}`"
         try:
             return await handler(args)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception as e:
             return f"ERROR: {e!r}"
 
@@ -322,6 +325,13 @@ class FdbCli:
         )
         return f"Failover to region `{args[0]}' initiated"
 
+    async def _cmd_lint(self, args) -> str:
+        """lint [--json] — run flowlint over this checkout (no cluster
+        needed; also available as `python -m foundationdb_tpu.tools.cli
+        lint`). Prints per-rule fail/baseline/disabled counts and the
+        host-only manifest."""
+        return _run_lint(list(args))[1]
+
     async def _cmd_configure(self, args) -> str:
         changes = {}
         for a in args:
@@ -333,14 +343,41 @@ class FdbCli:
         return "Configuration changed; recovery triggered"
 
 
+def _run_lint(args: list) -> tuple:
+    """(exit_code, rendered_output) for the flowlint static analyzer —
+    shared by the `lint` subcommand and the in-shell `lint` command."""
+    import json as _json
+
+    from .flowlint import lint, load_config
+    from .flowlint.__main__ import render
+
+    config = load_config()
+    result = lint(config=config)
+    if "--json" in args:
+        out = _json.dumps(result.to_json(), indent=2)
+    else:
+        out = render(result, config)
+    return (0 if result.clean else 1), out
+
+
 def main(argv=None) -> int:
     """fdbcli over real TCP: connect to a running cluster's coordinators.
 
       python -m foundationdb_tpu.tools.cli -C 127.0.0.1:4500 --exec "set k v"
 
-    Without --exec, reads commands from stdin (one per line)."""
+    Without --exec, reads commands from stdin (one per line). The `lint`
+    subcommand runs the flowlint static analyzer instead (no cluster):
+
+      python -m foundationdb_tpu.tools.cli lint [--json]
+    """
     import argparse
     import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        rc, out = _run_lint(argv[1:])
+        print(out, flush=True)
+        return rc
 
     ap = argparse.ArgumentParser(prog="fdbcli")
     ap.add_argument("-C", "--cluster", required=True, help="coordinator list")

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from ..runtime.futures import delay
 from . import Workload
+from ..runtime.loop import Cancelled
 
 
 class RollbackWorkload(Workload):
@@ -119,6 +120,8 @@ class RandomMoveKeysWorkload(Workload):
                     ready_timeout=20.0,
                 )
                 self.moved += 1
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue  # lost the lock race / mid-move failure: fine
 
@@ -156,6 +159,8 @@ class ChangeConfigWorkload(Workload):
                     self.db, self.coordinators, self.db.client, **change
                 )
                 self.changed += 1
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 continue  # a racing recovery can eat the force; fine
 

@@ -21,6 +21,7 @@ from ..net.sim import Endpoint
 from ..runtime.futures import delay, timeout
 from ..server.interfaces import Tokens
 from ..server.movekeys import walk_shards as _walk_shards
+from ..runtime.loop import Cancelled
 
 
 async def quiet_database(db, max_wait: float = 120.0, settle_polls: int = 2) -> None:
@@ -54,6 +55,8 @@ async def quiet_database(db, max_wait: float = 120.0, settle_polls: int = 2) -> 
             else:
                 stable = 0
             prev = shards
+        except Cancelled:
+            raise  # actor-cancelled-swallow
         except Exception:
             prev, stable = None, 0  # mid-recovery: start over
         await delay(1.0)

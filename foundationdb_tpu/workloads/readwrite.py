@@ -19,6 +19,7 @@ import time
 
 from ..runtime.futures import spawn, wait_for_all
 from . import Workload
+from ..runtime.loop import Cancelled
 
 
 def _pct(sorted_vals, p):
@@ -150,6 +151,8 @@ class ReadWriteWorkload(Workload):
                 rec.writes += self.writes_per_txn
                 rec.commits += 1
                 return
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception as e:
                 rec.conflicts += 1
                 await tr.on_error(e)

@@ -8,6 +8,7 @@ traffic spreads. Returns (moves, gained_per_resolver)."""
 from __future__ import annotations
 
 from ..runtime.futures import delay
+from ..runtime.loop import Cancelled
 
 
 async def hot_prefix_rebalance(cluster, db, balancer, bursts=(150, 150)):
@@ -21,6 +22,8 @@ async def hot_prefix_rebalance(cluster, db, balancer, bursts=(150, 150)):
             tr.set(k, b"v%d" % i)
             try:
                 await tr.commit()
+            except Cancelled:
+                raise  # actor-cancelled-swallow
             except Exception:
                 pass
 

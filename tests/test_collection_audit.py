@@ -10,11 +10,21 @@ green while coverage quietly shrinks):
 - the selector/bindingtester conformance batteries must stay inside the
   tier-1 budget: no `slow` markers (the tier-1 filter is `-m 'not
   slow'`), so the acceptance-gating tests cannot be quietly opted out.
+
+The metrics-registration and span-coverage lints that used to live here
+as inspect/regex assertions are now flowlint rules (reg-role-metrics,
+reg-endpoint-span in foundationdb_tpu/tools/flowlint) with real
+cross-module resolution. This file keeps their coverage honest: the old
+positive assertions run as fixture tests against the new rules (flag +
+near-miss on a synthetic worker/role tree), and the tree-level clean
+checks run through the same engine tier-1 gates on in test_flowlint.py.
 """
 
 import importlib.util
 import pathlib
 import sys
+
+from foundationdb_tpu.tools.flowlint import lint, load_config
 
 TESTS = pathlib.Path(__file__).resolve().parent
 
@@ -44,115 +54,160 @@ def test_every_test_module_imports():
     )
 
 
+# ---------------------------------------------------------------------------
+# Registration-integrity rules: tree-level clean + fixture coverage.
+
+
+def _reg_findings(rule_ids):
+    res = lint()
+    return [f for f in res.failing if f.rule in rule_ids]
+
+
 def test_every_server_role_registers_metrics():
-    """Metrics-registration lint: every server role class must expose a
-    CounterCollection (`self.stats = CounterCollection(...)`) and register
-    a `<role>.metrics#<uid>` endpoint, so new roles can't ship dark — the
-    status pipeline aggregates exactly these (worker._role_metrics +
-    Status's per-role pulls)."""
-    import inspect
-    import re
-
-    from foundationdb_tpu.server import worker as worker_mod
-
-    # role kind → class, mirroring Worker._make_* dispatch. `master` is a
-    # transient recovery-coordinator actor FUNCTION (its long-lived
-    # subsystems — DD, Ratekeeper — live behind master.* endpoints), so it
-    # is exempt by design, not by omission.
-    from foundationdb_tpu.server.log_router import LogRouter
-    from foundationdb_tpu.server.proxy import Proxy
-    from foundationdb_tpu.server.resolver import Resolver
-    from foundationdb_tpu.server.storage import StorageServer
-    from foundationdb_tpu.server.tlog import TLog
-
-    role_classes = {
-        "tlog": TLog,
-        "log_router": LogRouter,
-        "resolver": Resolver,
-        "proxy": Proxy,
-        "storage": StorageServer,
-    }
-    exempt = {"master"}
-
-    # the registry above must cover every recruitable role kind: a new
-    # _make_<role> without a lint entry fails here first
-    kinds = set(
-        re.findall(r"def _make_(\w+)\(", inspect.getsource(worker_mod.Worker))
+    """Every recruitable role class owns a CounterCollection and a
+    `*.metrics#` endpoint — now enforced by the reg-role-metrics flowlint
+    rule, which resolves Worker._make_<kind> factories to the role class
+    they instantiate across modules (no inspect regexes). `master` stays
+    exempt via flowlint config role_exempt: it is a transient
+    recovery-coordinator actor FUNCTION whose long-lived subsystems (DD,
+    Ratekeeper) live behind master.* endpoints — exempt by design, with
+    the reason recorded in config.json, not by omission."""
+    assert not _reg_findings({"reg-role-metrics"}), _reg_findings(
+        {"reg-role-metrics"}
     )
-    missing = kinds - set(role_classes) - exempt
-    assert not missing, f"role kinds without a metrics-lint entry: {missing}"
-
-    for kind, cls in role_classes.items():
-        src = inspect.getsource(cls)
-        assert re.search(r"self\.stats\s*=\s*CounterCollection\(", src), (
-            f"{kind}: role class {cls.__name__} has no CounterCollection — "
-            f"its traffic would be invisible to status/trace"
-        )
-        assert re.search(r"\.metrics#", src), (
-            f"{kind}: role class {cls.__name__} registers no *.metrics# "
-            f"endpoint — the status aggregator could not pull it"
-        )
+    config = load_config()
+    assert config["role_exempt"] == ["master"]
 
 
-def test_rpc_endpoints_open_spans_or_are_allowlisted():
-    """Span-coverage lint: every RPC endpoint a proxy/storage/resolver
-    registers must either open a distributed-trace span (runtime/trace.py
-    ``span(``) in its handler, or sit on the explicit allowlist below —
-    so a new client-facing endpoint can't ship invisible to the read/
-    commit waterfalls the perf PRs cite."""
-    import inspect
-    import re
+def test_rpc_endpoints_open_spans_or_are_exempted_inline():
+    """Every RPC endpoint a proxy/storage/resolver registers opens a
+    distributed-trace span — now the reg-endpoint-span flowlint rule.
+    Exemptions moved from this file's ALLOW dict to inline
+    `# flowlint: disable=reg-endpoint-span` comments ON the handler def
+    lines (admin/metrics/liveness endpoints and long-polls), so the
+    exemption travels with the code it excuses. A new endpoint without a
+    span and without an inline exemption fails here."""
+    assert not _reg_findings({"reg-endpoint-span"}), _reg_findings(
+        {"reg-endpoint-span"}
+    )
+    # the old ALLOW set survives as inline disables: count them so a bulk
+    # deletion (or a rule that silently stopped firing) is visible
+    res = lint()
+    disabled = [f for f in res.disabled if f.rule == "reg-endpoint-span"]
+    assert len(disabled) >= 10, (
+        "the span-endpoint exemption set shrank suspiciously — if "
+        "endpoints gained real spans, great, update this floor; if the "
+        "rule went blind, fix it"
+    )
 
-    from foundationdb_tpu.server.proxy import Proxy
-    from foundationdb_tpu.server.resolver import Resolver
-    from foundationdb_tpu.server.storage import StorageServer
 
-    # admin/metrics/liveness endpoints (no client-visible latency to
-    # attribute) and long-polls (a span covering a parked watch would
-    # report minutes of "latency"): exempt BY NAME, never by default
-    ALLOW = {
-        "proxy": {"_ping", "_metrics", "_raw_committed"},
-        "resolver": {"_ping", "_metrics", "_resolution_metrics", "_split_point"},
-        "storage": {
-            "_ping",
-            "_metrics",
-            "_get_version",
-            "_owned_ranges",
-            "get_shard_state",
-            "get_shard_metrics",
-            "get_split_key",
-            "watch_value",  # long-poll: parks until the value changes
-        },
+# ---------------------------------------------------------------------------
+# Fixture tests: the old assertions, replayed as flag/near-miss trees
+# against the new rules (coverage must not shrink in the migration).
+
+_WORKER = """\
+class Worker:
+    def _make_widget(self, h):
+        from .widget import Widget
+        w = Widget()
+        return w
+"""
+
+_ROLE_OK = """\
+from ..runtime.stats import CounterCollection
+
+class Widget:
+    def __init__(self):
+        self.stats = CounterCollection("widget")
+
+    def register_instance(self, process):
+        process.register(f"widget.metrics#{id(self)}", self._metrics)
+        process.register("widget.work", self.work)
+
+    async def _metrics(self, _req):  # flowlint: disable=reg-endpoint-span
+        return self.stats.snapshot()
+
+    async def work(self, req):
+        from ..runtime.trace import span
+        with span("Widget.work"):
+            return req
+"""
+
+
+def _lint_tree(tmp_path, worker_src, role_src, span_roles=("widget",)):
+    pkg = tmp_path / "foundationdb_tpu" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "worker.py").write_text(worker_src)
+    (pkg / "widget.py").write_text(role_src)
+    config = {
+        "include": ["foundationdb_tpu"],
+        "exclude": [],
+        "sim_scope": [],
+        "host_only": {},
+        "baseline": "baseline.json",
+        "worker_module": "foundationdb_tpu/server/worker.py",
+        "role_exempt": [],
+        "span_roles": list(span_roles),
     }
+    return lint(root=tmp_path, config=config)
 
-    for kind, cls in (
-        ("proxy", Proxy),
-        ("resolver", Resolver),
-        ("storage", StorageServer),
-    ):
-        handlers = set()
-        for meth in ("register", "register_instance", "register_endpoints"):
-            fn = getattr(cls, meth, None)
-            if fn is None:
-                continue
-            handlers |= set(
-                re.findall(
-                    r"process\.register\([^,]+,\s*self\.(\w+)\)",
-                    inspect.getsource(fn),
-                )
-            )
-        assert handlers, f"{kind}: no registered endpoints found by the lint"
-        missing = []
-        for h in sorted(handlers):
-            if h in ALLOW[kind]:
-                continue
-            if "span(" not in inspect.getsource(getattr(cls, h)):
-                missing.append(h)
-        assert not missing, (
-            f"{kind}: endpoints with neither a span nor an allowlist "
-            f"entry: {missing} — open a span (runtime/trace.py) or add an "
-            f"explicit exemption here"
-        )
+
+def test_rule_fixture_role_with_metrics_and_spans_passes(tmp_path):
+    res = _lint_tree(tmp_path, _WORKER, _ROLE_OK)
+    assert not res.failing, [f.format() for f in res.failing]
+
+
+def test_rule_fixture_missing_counter_collection_flagged(tmp_path):
+    role = _ROLE_OK.replace('        self.stats = CounterCollection("widget")\n', "        pass\n")
+    res = _lint_tree(tmp_path, _WORKER, role)
+    assert any(
+        f.rule == "reg-role-metrics" and f.detail == "Widget-stats"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+
+
+def test_rule_fixture_missing_metrics_endpoint_flagged(tmp_path):
+    role = _ROLE_OK.replace("widget.metrics#", "widget.admin#")
+    res = _lint_tree(tmp_path, _WORKER, role)
+    assert any(
+        f.rule == "reg-role-metrics" and f.detail == "Widget-endpoint"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+
+
+def test_rule_fixture_unresolvable_factory_flagged(tmp_path):
+    """The old test asserted every _make_<kind> had a lint entry; the rule
+    analog: a factory whose role class cannot be resolved is itself a
+    finding (add it to role_exempt with a reason, or fix the factory)."""
+    worker = _WORKER + (
+        "\n"
+        "    def _make_mystery(self, h):\n"
+        "        return object()\n"
+    )
+    res = _lint_tree(tmp_path, worker, _ROLE_OK)
+    assert any(
+        f.rule == "reg-role-metrics" and f.detail == "unresolved-mystery"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+
+
+def test_rule_fixture_spanless_endpoint_flagged_and_disable_exempts(tmp_path):
+    spanless = _ROLE_OK.replace(
+        "        from ..runtime.trace import span\n"
+        '        with span("Widget.work"):\n'
+        "            return req\n",
+        "        return req\n",
+    )
+    res = _lint_tree(tmp_path, _WORKER, spanless)
+    assert any(
+        f.rule == "reg-endpoint-span" and f.detail == "Widget.work"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+    # the _metrics handler carries an inline disable: exempted, visible
+    assert any(
+        f.rule == "reg-endpoint-span" and f.detail == "Widget._metrics"
+        for f in res.disabled
+    )
 
 
 def test_acceptance_batteries_not_slow_marked():

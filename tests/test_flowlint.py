@@ -1,0 +1,329 @@
+"""flowlint: per-rule fixtures, suppressions, baseline, and the tier-1 gate.
+
+Layout mirrors the analyzer's contract:
+
+- every shipped rule has >= 1 minimal snippet it MUST flag and >= 1
+  near-miss it MUST NOT (a registry-coverage test makes adding a rule
+  without fixtures fail);
+- suppression comments and the baseline round-trip through the real
+  engine over a synthetic tree;
+- the gate: the real tree has ZERO unsuppressed findings, the baseline
+  is non-empty and non-stale (deleting an entry that guards a live site
+  fails here), and the whole analysis stays under 10s of wall time so it
+  never eats the tier-1 budget.
+"""
+
+import json
+import time
+
+import pytest
+
+from foundationdb_tpu.tools.flowlint import (
+    all_rules,
+    format_baseline,
+    lint,
+    lint_source,
+    load_config,
+)
+from foundationdb_tpu.tools.flowlint.core import DEFAULT_ROOT
+
+SIM = "foundationdb_tpu/runtime/mod.py"  # a sim-reachable relpath for fixtures
+
+
+def rule_hits(src, rule, relpath=SIM):
+    return [f for f in lint_source(src, relpath=relpath) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# Per-module rule fixtures: (flagged source, near-miss source)
+
+FIXTURES = {
+    "det-wall-clock": (
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n",
+        # a bare REFERENCE is dependency injection, not a clock read
+        "import time\n"
+        "def f(now_fn=time.perf_counter):\n"
+        "    return now_fn\n",
+    ),
+    "det-sleep": (
+        "import time as t\n"
+        "def f():\n"
+        "    t.sleep(1)\n",
+        "from ..runtime.futures import delay\n"
+        "async def f():\n"
+        "    await delay(1)\n",
+    ),
+    "det-entropy": (
+        "import os as _os\n"
+        "def seed():\n"
+        "    return _os.urandom(8)\n",
+        "def seed(loop):\n"
+        "    return loop.random.random_int(0, 1 << 30)\n",
+    ),
+    "det-unseeded-random": (
+        "import random\n"
+        "def f():\n"
+        "    return random.random()\n",
+        # seeded instance construction is the approved shape
+        "import random\n"
+        "def f(seed):\n"
+        "    return random.Random(seed).random()\n",
+    ),
+    "actor-dropped-future": (
+        "async def work():\n"
+        "    return 1\n"
+        "def boot():\n"
+        "    work()\n",
+        "async def work():\n"
+        "    return 1\n"
+        "async def main():\n"
+        "    await work()\n"
+        "def boot(process):\n"
+        "    process.spawn(work())\n",
+    ),
+    "actor-blocking-call": (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(0.1)\n",
+        # sync helpers may sleep (det-sleep polices sim scope separately)
+        "import time\n"
+        "def f():\n"
+        "    time.sleep(0.1)\n",
+    ),
+    "actor-cancelled-swallow": (
+        "async def f(fut):\n"
+        "    try:\n"
+        "        await fut\n"
+        "    except Exception:\n"
+        "        pass\n",
+        "async def f(fut):\n"
+        "    try:\n"
+        "        await fut\n"
+        "    except Cancelled:\n"
+        "        raise\n"
+        "    except Exception:\n"
+        "        pass\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_flags_and_near_miss(rule):
+    flagged, near_miss = FIXTURES[rule]
+    assert rule_hits(flagged, rule), f"{rule}: must flag the minimal snippet"
+    assert not rule_hits(near_miss, rule), f"{rule}: must pass the near-miss"
+
+
+def test_every_shipped_rule_has_a_fixture():
+    """Adding a rule without fixture coverage fails here first — the
+    project-scope rules have their flag/near-miss pairs in
+    test_collection_audit.py (they need a multi-file tree)."""
+    PROJECT_RULES_TESTED_ELSEWHERE = {"reg-role-metrics", "reg-endpoint-span"}
+    ids = {r.id for r in all_rules()}
+    covered = set(FIXTURES) | PROJECT_RULES_TESTED_ELSEWHERE
+    assert ids == covered, (
+        f"rules without fixtures: {ids - covered}; "
+        f"fixtures without rules: {covered - ids}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# More near-misses worth pinning
+
+def test_dropped_bare_spawn_flagged_but_held_spawn_passes():
+    flagged = (
+        "from ..runtime.futures import spawn\n"
+        "async def work():\n"
+        "    return 1\n"
+        "def boot():\n"
+        "    spawn(work())\n"
+    )
+    held = (
+        "from ..runtime.futures import spawn\n"
+        "async def work():\n"
+        "    return 1\n"
+        "def boot(actors):\n"
+        "    actors.add(spawn(work()))\n"
+    )
+    assert [f.detail for f in rule_hits(flagged, "actor-dropped-future")] == ["spawn"]
+    assert not rule_hits(held, "actor-dropped-future")
+
+
+def test_dropped_self_method_coroutine_in_init():
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.warm_up()\n"
+        "    async def warm_up(self):\n"
+        "        return 1\n"
+    )
+    hits = rule_hits(src, "actor-dropped-future")
+    assert [f.detail for f in hits] == ["self.warm_up"]
+    assert hits[0].scope == "C.__init__"
+
+
+def test_cancelled_swallow_requires_an_await_in_try():
+    src = (
+        "async def f(x):\n"
+        "    try:\n"
+        "        y = x + 1\n"
+        "    except Exception:\n"
+        "        y = 0\n"
+        "    return y\n"
+    )
+    assert not rule_hits(src, "actor-cancelled-swallow")
+
+
+def test_cancelled_swallow_reraise_passes():
+    src = (
+        "async def f(fut):\n"
+        "    try:\n"
+        "        await fut\n"
+        "    except Exception:\n"
+        "        raise\n"
+    )
+    assert not rule_hits(src, "actor-cancelled-swallow")
+
+
+def test_host_only_manifest_exempts_determinism_not_ad_hoc():
+    src = "import time\ndef f():\n    return time.time()\n"
+    host = "foundationdb_tpu/tools/tcp_soak.py"  # in the checked-in manifest
+    assert rule_hits(src, "det-wall-clock", relpath=SIM)
+    assert not rule_hits(src, "det-wall-clock", relpath=host)
+    # the manifest is config, not rule code
+    assert host in load_config()["host_only"]
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+
+def test_inline_disable_suppresses_only_named_rule_on_that_line():
+    base = "import time\ndef f():\n    return time.time(){}\n"
+    assert rule_hits(base.format(""), "det-wall-clock")
+    assert not rule_hits(
+        base.format("  # flowlint: disable=det-wall-clock"), "det-wall-clock"
+    )
+    # naming a different rule does not suppress
+    assert rule_hits(
+        base.format("  # flowlint: disable=det-sleep"), "det-wall-clock"
+    )
+
+
+def test_file_level_disable():
+    src = (
+        "# flowlint: disable-file=det-wall-clock\n"
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n"
+        "def g():\n"
+        "    return time.monotonic()\n"
+    )
+    assert not rule_hits(src, "det-wall-clock")
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip through the real engine over a synthetic tree
+
+def _mini_tree(tmp_path, baseline_entries=None):
+    pkg = tmp_path / "foundationdb_tpu" / "runtime"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n"
+    )
+    config = {
+        "include": ["foundationdb_tpu"],
+        "exclude": ["scratch", "tests"],
+        "sim_scope": ["foundationdb_tpu"],
+        "host_only": {},
+        "baseline": "baseline.json",
+        "role_exempt": [],
+        "span_roles": [],
+        "worker_module": "foundationdb_tpu/server/worker.py",
+    }
+    if baseline_entries is not None:
+        (tmp_path / "baseline.json").write_text(
+            json.dumps({"entries": baseline_entries})
+        )
+    return config
+
+
+def test_baseline_round_trip(tmp_path):
+    config = _mini_tree(tmp_path)
+    first = lint(root=tmp_path, config=config)
+    assert len(first.failing) == 1 and first.failing[0].rule == "det-wall-clock"
+
+    # write the baseline exactly as --write-baseline would
+    (tmp_path / "baseline.json").write_text(
+        format_baseline(first.failing, {first.failing[0].key: "known wall read"})
+    )
+    second = lint(root=tmp_path, config=config)
+    assert second.clean
+    assert [f.key for f in second.baselined] == [first.failing[0].key]
+    assert not second.stale_baseline
+
+    # deleting the entry resurrects the finding (the acceptance property)
+    third = lint(root=tmp_path, config=config, baseline={})
+    assert [f.key for f in third.failing] == [first.failing[0].key]
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    config = _mini_tree(
+        tmp_path, baseline_entries={"foundationdb_tpu/gone.py::f::det-sleep::time.sleep": "?"}
+    )
+    res = lint(root=tmp_path, config=config)
+    assert res.stale_baseline == [
+        "foundationdb_tpu/gone.py::f::det-sleep::time.sleep"
+    ]
+
+
+def test_baseline_key_is_line_churn_stable(tmp_path):
+    config = _mini_tree(tmp_path)
+    key0 = lint(root=tmp_path, config=config).failing[0].key
+    mod = tmp_path / "foundationdb_tpu" / "runtime" / "mod.py"
+    mod.write_text("# a new leading comment shifts every line\n" + mod.read_text())
+    assert lint(root=tmp_path, config=config).failing[0].key == key0
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate over the real tree
+
+def test_tree_is_flowlint_clean_within_budget():
+    t0 = time.perf_counter()
+    result = lint()
+    elapsed = time.perf_counter() - t0
+    assert not result.parse_errors, result.parse_errors
+    assert not result.failing, "unsuppressed flowlint findings:\n" + "\n".join(
+        f.format() for f in result.failing
+    )
+    # grandfathered sites stay visible and guarded: the baseline is real
+    # (delete an entry guarding a live site and `failing` catches it above),
+    # and it carries no dead keys
+    assert result.baselined, "baseline.json no longer guards any live site"
+    assert not result.stale_baseline, (
+        "stale baseline entries (sites gone — prune): "
+        + ", ".join(result.stale_baseline)
+    )
+    # inline disables in the tree are load-bearing too (RealLoop's clock,
+    # the kernel backends' host timings, the span allowlist)
+    assert len(result.disabled) >= 3
+    assert result.files > 100
+    assert elapsed < 10.0, f"flowlint took {elapsed:.1f}s — over the tier-1 budget"
+
+
+def test_host_only_manifest_points_at_real_files():
+    config = load_config()
+    for rel in config["host_only"]:
+        assert (DEFAULT_ROOT / rel).exists(), f"host_only manifest rot: {rel}"
+
+
+def test_cli_json_output_is_machine_readable():
+    from foundationdb_tpu.tools.cli import _run_lint
+
+    rc, out = _run_lint(["--json"])
+    doc = json.loads(out)
+    assert rc == 0 and doc["clean"] is True
+    assert set(doc["per_rule"]) == {r.id for r in all_rules()}
