@@ -80,8 +80,10 @@ class SimProcess:
         self.endpoints[token] = handler
         return Endpoint(self.address, token)
 
-    def spawn(self, coro, priority: int = TaskPriority.DEFAULT) -> Future:
-        fut = spawn(coro, priority)
+    def spawn(
+        self, coro, priority: int = TaskPriority.DEFAULT, name: str = None
+    ) -> Future:
+        fut = spawn(coro, priority, name)
         self.actors.add(fut)
         return fut
 
@@ -98,6 +100,12 @@ class Sim:
     ):
         self.loop = EventLoop(seed)
         self.knobs = knobs or Knobs()
+        # run-loop profiler, SIM personality: deterministic per-actor step
+        # counters + virtual starvation samples; no wall-dependent trace
+        # events (SlowTask is the real personality's)
+        from ..runtime import profiler as _profiler
+
+        _profiler.install(self.loop, knobs=self.knobs, wall=False, ident="sim")
         # chaos=True arms BUGGIFY sites (flow/flow.h:60) with this sim's
         # seeded rng; activate() installs it so concurrent test sims
         # cannot cross-contaminate
@@ -215,7 +223,13 @@ class Sim:
 
             prev = _trace.swap_active_span(span_ctx)
             try:
-                dst.spawn(run_and_reply())
+                # run-loop attribution: the dispatch wrapper is anonymous
+                # plumbing — name the task after the HANDLER so profiler
+                # output reads "StorageServer.get_value", not run_and_reply
+                dst.spawn(
+                    run_and_reply(),
+                    name=getattr(handler, "__qualname__", None),
+                )
             finally:
                 _trace.swap_active_span(prev)
 

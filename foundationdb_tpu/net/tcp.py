@@ -303,8 +303,10 @@ class RealNode:
         self.endpoints[token] = handler
         return Endpoint(self.address, token)
 
-    def spawn(self, coro, priority: int = TaskPriority.DEFAULT) -> Future:
-        fut = spawn(coro, priority)
+    def spawn(
+        self, coro, priority: int = TaskPriority.DEFAULT, name: str = None
+    ) -> Future:
+        fut = spawn(coro, priority, name)
         self.actors.add(fut)
         return fut
 
@@ -363,6 +365,15 @@ class RealWorld:
         self._next_id = 1
         self._listener: Optional[socket.socket] = None
         self._listen()
+        # run-loop profiler, REAL personality: wall busy/starvation + the
+        # SlowTask trace events. Installed after _listen so the ident is
+        # the node's final address (ephemeral ports are adopted there);
+        # several worlds may share one loop — the first install wins
+        from ..runtime import profiler as _profiler
+
+        _profiler.install(
+            self.loop, knobs=self.knobs, wall=True, ident=self.node.address
+        )
 
     # -- Sim-compatible world surface -----------------------------------------
 
@@ -648,7 +659,10 @@ class RealWorld:
             if not reply.is_ready():
                 reply._set(result)
 
-        self.node.spawn(run_and_reply())
+        # profiler attribution names the handler, not the dispatch shim
+        self.node.spawn(
+            run_and_reply(), name=getattr(handler, "__qualname__", None)
+        )
 
     def _on_message(self, conn: _Conn, msg) -> None:
         kind = msg[0]
@@ -686,7 +700,10 @@ class RealWorld:
 
             prev = _trace.swap_active_span(span_ctx)
             try:
-                self.node.spawn(run_and_reply())
+                # profiler attribution names the handler, not the shim
+                self.node.spawn(
+                    run_and_reply(), name=getattr(handler, "__qualname__", None)
+                )
             finally:
                 _trace.swap_active_span(prev)
         elif kind == "ok":

@@ -107,11 +107,19 @@ class Promise(Generic[T]):
 class Task:
     """Drives a coroutine on the loop; the generated actor state machine."""
 
-    def __init__(self, coro, priority: int = TaskPriority.DEFAULT):
+    def __init__(self, coro, priority: int = TaskPriority.DEFAULT, name: str = None):
         self.coro = coro
         self.future: Future = Future()
         self.future._task = self
         self.priority = priority
+        # actor identity for run-loop attribution (runtime/profiler.py):
+        # the coroutine's qualname names the async def that IS the actor,
+        # threaded through every (re)schedule so the loop can attribute
+        # each callback's on-CPU time to its owner. RPC dispatch overrides
+        # it with the handler's qualname (the wrapper is anonymous plumbing).
+        self.name = (
+            name or getattr(coro, "__qualname__", None) or type(coro).__name__
+        )
         self._cancelled = False
         self._waiting_on: Optional[Future] = None
         # home loop: every (re)scheduling of this task goes here, NOT to
@@ -125,7 +133,7 @@ class Task:
         self._span_ctx = _trace.active_span()
 
     def start(self) -> Future:
-        self.loop.call_soon(lambda: self._step(None, None), self.priority)
+        self.loop.call_soon(lambda: self._step(None, None), self.priority, self.name)
         return self.future
 
     def cancel(self) -> None:
@@ -133,7 +141,7 @@ class Task:
             return
         self._cancelled = True
         self.loop.call_soon(
-            lambda: self._step(None, Cancelled()), TaskPriority.MAX
+            lambda: self._step(None, Cancelled()), TaskPriority.MAX, self.name
         )
 
     def _step(self, value, error) -> None:
@@ -174,7 +182,7 @@ class Task:
             # keep re-throwing at every await until the body exits, so an
             # actor that catches Cancelled and awaits again can't hang forever
             self.loop.call_soon(
-                lambda: self._step(None, Cancelled()), TaskPriority.MAX
+                lambda: self._step(None, Cancelled()), TaskPriority.MAX, self.name
             )
             return
         self._waiting_on = awaited
@@ -191,19 +199,21 @@ class Task:
                 return
             if f._error is not None:
                 task.loop.call_soon(
-                    lambda: task._step(None, f._error), task.priority
+                    lambda: task._step(None, f._error), task.priority, task.name
                 )
             else:
                 task.loop.call_soon(
-                    lambda: task._step(f._value, None), task.priority
+                    lambda: task._step(f._value, None), task.priority, task.name
                 )
 
         awaited.add_callback(wake)
 
 
-def spawn(coro, priority: int = TaskPriority.DEFAULT) -> Future:
-    """Run an async def body as an actor; returns its future (cancellable)."""
-    return Task(coro, priority).start()
+def spawn(coro, priority: int = TaskPriority.DEFAULT, name: str = None) -> Future:
+    """Run an async def body as an actor; returns its future (cancellable).
+    ``name`` overrides the profiler attribution (defaults to the
+    coroutine's qualname)."""
+    return Task(coro, priority, name).start()
 
 
 # ---------------------------------------------------------------------------

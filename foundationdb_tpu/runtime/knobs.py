@@ -70,6 +70,12 @@ class Knobs:
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
     # observability
+    # run-loop profiler (runtime/profiler.py): per-actor busy attribution,
+    # per-priority starvation, SlowTask events (the reference's run-loop
+    # profiler + NetworkMetrics, flow/Net2.actor.cpp)
+    RUN_LOOP_PROFILER = True
+    RUN_LOOP_SLOW_TASK_MS = 50.0  # real-loop callbacks above this trace SlowTask
+    PROFILER_SAMPLE_HZ = 100.0  # flame sampler rate (cli profile)
     TRACE_ROLL_BYTES = 10 << 20  # roll the JSONL trace file here (reference: 10 MB)
     TRACE_ROLL_KEEP = 10  # rolled files kept (path.1 .. path.N)
     # fraction of client transactions that open a sampled distributed

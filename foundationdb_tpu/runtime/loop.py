@@ -44,18 +44,26 @@ class EventLoop:
     comes from (time, -priority, seq) ordering and the seeded RNG."""
 
     def __init__(self, seed: int = 0):
-        self._queue: list[tuple[float, int, int, Callable[[], None]]] = []
+        self._queue: list[tuple] = []  # (when, -priority, seq, fn, owner)
         self._time = 0.0
         self._seq = 0
         self.random = DeterministicRandom(seed)
         self.stopped = False
         self._stall_detector: Optional[Callable[[], None]] = None
+        # run-loop profiler (runtime/profiler.py), installed by the world
+        # constructors behind the RUN_LOOP_PROFILER knob; when present,
+        # every callback executes under per-actor/per-band attribution
+        self.profiler = None
 
     def now(self) -> float:
         return self._time
 
     def call_at(
-        self, when: float, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT
+        self,
+        when: float,
+        fn: Callable[[], None],
+        priority: int = TaskPriority.DEFAULT,
+        owner: Optional[str] = None,
     ) -> None:
         # reentrancy-safe: a GC run triggered by the allocations below can
         # finalize coroutines whose finally-blocks schedule more callbacks
@@ -63,23 +71,35 @@ class EventLoop:
         # two entries can share one and the heap falls over comparing the
         # callables
         seq = self._seq = self._seq + 1
-        heapq.heappush(self._queue, (max(when, self._time), -priority, seq, fn))
+        heapq.heappush(
+            self._queue, (max(when, self._time), -priority, seq, fn, owner)
+        )
 
     def call_soon(
-        self, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT
+        self,
+        fn: Callable[[], None],
+        priority: int = TaskPriority.DEFAULT,
+        owner: Optional[str] = None,
     ) -> None:
-        self.call_at(self._time, fn, priority)
+        self.call_at(self._time, fn, priority, owner)
 
     def run(self, until: float = float("inf"), stop_when: Callable[[], bool] = None):
         """Drain tasks until the queue empties, virtual time passes ``until``,
         or ``stop_when()`` turns true."""
         while self._queue and not self.stopped:
-            when, negpri, seq, fn = self._queue[0]
+            when, negpri, _seq, fn, owner = self._queue[0]
             if when > until:
                 break
             heapq.heappop(self._queue)
             self._time = max(self._time, when)
-            fn()
+            prof = self.profiler
+            if prof is None:
+                fn()
+            else:
+                # virtual schedule→run lag: deterministically ~0 here (the
+                # sim warps time to the due instant), but the call keeps
+                # one code path for both personalities
+                prof.run_task(fn, owner, -negpri, self._time - when)
             if stop_when is not None and stop_when():
                 break
         return self._time
@@ -135,8 +155,15 @@ class RealLoop(EventLoop):
             pass
 
     def close(self) -> None:
-        """Release the wake pipe (a loop is one-per-process in production,
-        but tests create many)."""
+        """Release the wake pipe AND the selector (a loop is one-per-process
+        in production, but tests create many — an unclosed selector leaks
+        one epoll fd per loop until the fd table fills). Idempotent: the
+        __del__ backstop and explicit close may both run."""
+        if self.profiler is not None:
+            try:
+                self.profiler.flame_stop()  # sampler thread must not outlive us
+            except Exception:
+                pass
         try:
             self.remove_reader(self._wake_r)
         except Exception:
@@ -146,6 +173,10 @@ class RealLoop(EventLoop):
                 s.close()
             except OSError:
                 pass
+        try:
+            self._selector.close()
+        except (OSError, RuntimeError):
+            pass
 
     def __del__(self):  # backstop for leak-prone test loops
         self.close()
@@ -228,13 +259,19 @@ class RealLoop(EventLoop):
         import selectors
 
         while not self.stopped:
+            prof = self.profiler
             while self._posted:
                 self.call_soon(self._posted.popleft())
             self._time = self._wall()
             # drain due callbacks
             while self._queue and self._queue[0][0] <= self._time:
-                _w, _p, _s, fn = heapq.heappop(self._queue)
-                fn()
+                when, negpri, _s, fn, owner = heapq.heappop(self._queue)
+                if prof is None:
+                    fn()
+                else:
+                    # wall schedule→run lag: how long past due this task
+                    # ran — the starvation the blocked loop inflicted
+                    prof.run_task(fn, owner, -negpri, self._time - when)
                 if stop_when is not None and stop_when():
                     return self._time
                 self._time = self._wall()
@@ -254,12 +291,24 @@ class RealLoop(EventLoop):
                 wait = max(0.0, min(wait, self._queue[0][0] - self._time))
             if until != float("inf"):
                 wait = max(0.0, min(wait, until - self._time))
-            for key, events in self._selector.select(wait):
+            if prof is None:
+                ready = self._selector.select(wait)
+            else:
+                t0 = self._monotonic()
+                ready = self._selector.select(wait)
+                prof.select_done(self._monotonic() - t0)
+            for key, events in ready:
                 rd, wr = key.data
                 if events & selectors.EVENT_READ and rd is not None:
-                    rd()
+                    rd() if prof is None else prof.run_io(rd)
                 if events & selectors.EVENT_WRITE and wr is not None:
-                    wr()
+                    wr() if prof is None else prof.run_io(wr)
+            # a stop condition satisfied inside an IO callback must end the
+            # run NOW — falling through to the next cycle would execute
+            # whatever timers are due (and, with an empty selector map,
+            # could park in select again) before anyone re-consulted it
+            if stop_when is not None and stop_when():
+                return self._time
         return self._time
 
 

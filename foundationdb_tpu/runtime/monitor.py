@@ -59,6 +59,14 @@ async def system_monitor(process, interval: float = 5.0):
             MemoryKB=rss_kb,
             PeakMemoryKB=peak_kb,
         )
+        # run-loop profiler vitals (runtime/profiler.py) when installed:
+        # the headline numbers an operator scans ProcessMetrics for before
+        # reaching for `cli top` / the process.metrics snapshot
+        prof = getattr(loop, "profiler", None)
+        if prof is not None:
+            sample["LoopSteps"] = prof._c_steps.value
+            sample["SlowTasks"] = prof._c_slow.value
+            sample["LoopBusyFraction"] = round(prof.busy_fraction(), 6)
         # latest sample stays readable on demand (the status document's
         # machine/process sections pull it through worker.systemMetrics)
         process.last_process_metrics = sample

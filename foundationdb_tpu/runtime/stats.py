@@ -106,6 +106,21 @@ class LatencySample:
             "p99": round(pick(0.99), 6),
         }
 
+    @staticmethod
+    def merge(snaps: list) -> dict:
+        """Aggregate sample snapshots from many loops/roles: counts sum,
+        percentiles take the WORST (a cluster-wide p99 cannot be computed
+        from per-role percentiles, but the worst observed band is exactly
+        what an operator scanning for starvation wants)."""
+        out = {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        for s in snaps:
+            if not s:
+                continue
+            out["count"] += s.get("count") or 0
+            for k in ("p50", "p95", "p99"):
+                out[k] = max(out[k], s.get(k) or 0.0)
+        return out
+
 
 # default band edges (seconds) — the reference's LatencyBands knob
 # thresholds scaled to this system's sim/TCP latency envelope: sub-ms

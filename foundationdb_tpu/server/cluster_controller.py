@@ -474,7 +474,8 @@ class ClusterController:
             sf = self.process.spawn(
                 pull_one(address, "worker.systemMetrics")
             )
-            return address, await mf, await sf
+            pf = self.process.spawn(pull_one(address, "process.metrics"))
+            return address, await mf, await sf, await pf
 
         from ..runtime.futures import wait_for_all
 
@@ -483,14 +484,21 @@ class ClusterController:
         )
         # machine/process sections (Status.actor.cpp processStatus /
         # machineStatus): the SystemMonitor vitals per process, rolled up
-        # per machine
+        # per machine — plus the run-loop profiler snapshot per process
+        # (slow tasks, per-priority starvation, hot actors; consumers
+        # dedupe shared loops on `loop_id` — every sim process reports the
+        # one loop they all share)
         processes = {}
-        for address, metrics, sysm in pulls:
+        run_loop = {}
+        for address, metrics, sysm, proc in pulls:
             if metrics:
                 workers[address]["metrics"] = metrics
             if sysm:
                 processes[address] = sysm
+            if proc:
+                run_loop[address] = proc
         doc["processes"] = processes
+        doc["run_loop"] = run_loop
         machines: dict = {}
         for address, sysm in processes.items():
             mkey = workers[address].get("machine") or address

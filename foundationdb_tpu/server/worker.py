@@ -80,9 +80,20 @@ class Worker:
         p.register(Tokens.WORKER_DESTROY_ROLE, self._destroy_role_req)
         p.register("worker.metrics", self._role_metrics)
         p.register("worker.systemMetrics", self._system_metrics)
+        p.register("process.metrics", self._process_metrics)
+        from ..runtime.loop import current_loop
         from ..runtime.monitor import system_monitor
 
         p.spawn(system_monitor(p, interval=2.0))
+        prof = getattr(current_loop(), "profiler", None)
+        if prof is not None:
+            # periodic RunLoopMetrics trace events; the profiler hands the
+            # loop to exactly ONE worker (sim processes share a loop)
+            p.spawn(
+                prof.ensure_trace_loop(
+                    self.knobs.METRICS_TRACE_INTERVAL, p.address
+                )
+            )
         p.spawn(self._rescan_disk())  # reboot: resurrect durable roles
         p.spawn(monitor_leader(p, self.coordinators, self.leader))
         p.spawn(self._registration_client())
@@ -130,6 +141,16 @@ class Worker:
 
     async def _ping(self, _req):
         return "pong"
+
+    async def _process_metrics(self, _req) -> dict:
+        """Run-loop profiler snapshot for this process's loop (the
+        `process.metrics` endpoint behind the status document's `run_loop`
+        section and `cli top`) — per-actor busy attribution, per-priority
+        starvation bands, slow-task counts."""
+        from ..runtime.loop import current_loop
+
+        prof = getattr(current_loop(), "profiler", None)
+        return prof.snapshot() if prof is not None else {}
 
     async def _system_metrics(self, _req) -> dict:
         """The SystemMonitor's latest ProcessMetrics sample (status's

@@ -142,6 +142,24 @@ def snapshot(result, platform):
             "stages[%s]: p50=%sms over %s traces  %s"
             % (root, agg.get("p50_ms"), agg.get("traces"), top)
         )
+    # run-loop profiler provenance (perf embeds the snapshot next to the
+    # kernel counters): a capture whose loop spent half its time in host
+    # encode or paid SlowTask stalls says so next to its number
+    rl = entry.get("run_loop") or {}
+    if rl:
+        hot = ", ".join(
+            "%s=%sms" % (a.get("name"), round((a.get("busy_seconds") or 0) * 1e3, 1))
+            for a in (rl.get("hot_actors") or [])[:3]
+        )
+        log(
+            "run_loop: steps=%s slow_tasks=%s busy=%s%%  %s"
+            % (
+                rl.get("steps"),
+                rl.get("slow_tasks"),
+                round(100 * (rl.get("busy_fraction") or 0), 1),
+                hot,
+            )
+        )
 
 
 _EVIDENCE_DONE = False

@@ -165,6 +165,7 @@ class FdbCli:
                     else ""
                 )
             )
+        lines.extend(_format_run_loop(doc.get("run_loop") or {}))
         if args and args[0] == "details":
             # machine/process sections (fdbcli `status details`)
             machines = doc.get("machines", {})
@@ -258,6 +259,97 @@ class FdbCli:
             lines.append(f"  ... and {len(traces) - 25} more")
         return "\n".join(lines)
 
+    async def _cmd_top(self, args) -> str:
+        """top [N] — hottest actors by run-loop busy time, merged across
+        the cluster's loops (the profiler's answer to "who is holding the
+        run loop"; fdbtop-style view of runtime/profiler.py)."""
+        n = int(args[0]) if args else 10
+        rl = {}
+        try:
+            doc = await management.get_status(self.coordinators, self.db.client)
+            rl = doc.get("run_loop") or {}
+        except Cancelled:
+            raise  # actor-cancelled-swallow
+        except Exception:
+            rl = {}
+        if not rl:
+            # no cluster/status (or profiler off everywhere): fall back to
+            # this process's own loop
+            from ..runtime.loop import current_loop
+
+            prof = getattr(current_loop(), "profiler", None)
+            if prof is None:
+                return "no run-loop profiler (RUN_LOOP_PROFILER knob off)"
+            rl = {"local": prof.snapshot(top=max(n, 10))}
+        loops = _dedupe_loops(rl)
+        merged: dict[str, dict] = {}
+        for _addr, snap in loops.values():
+            for a in snap.get("hot_actors") or []:
+                m = merged.setdefault(
+                    a["name"], {"steps": 0, "busy_seconds": 0.0, "max_ms": 0.0}
+                )
+                m["steps"] += a.get("steps") or 0
+                m["busy_seconds"] += a.get("busy_seconds") or 0.0
+                m["max_ms"] = max(m["max_ms"], a.get("max_ms") or 0.0)
+        if not merged:
+            return "no run-loop samples yet"
+        slow = sum(s.get("slow_tasks") or 0 for _a, s in loops.values())
+        lines = [
+            f"hot actors by run-loop busy time "
+            f"({len(loops)} loop(s), {slow} slow tasks):",
+            f"{'busy ms':>10}  {'steps':>8}  {'max ms':>8}  actor",
+        ]
+        rows = sorted(
+            merged.items(),
+            key=lambda kv: (-kv[1]["busy_seconds"], -kv[1]["steps"], kv[0]),
+        )[:n]
+        for name, m in rows:
+            lines.append(
+                f"{m['busy_seconds'] * 1000:10.2f}  {m['steps']:8d}  "
+                f"{m['max_ms']:8.2f}  {name}"
+            )
+        return "\n".join(lines)
+
+    async def _cmd_profile(self, args) -> str:
+        """profile start [hz]        — begin sampling this loop's thread
+        profile stop [path]       — stop; print folded stacks (or write)
+        profile <seconds> [path]  — sample for a duration, then dump
+        Folded-stack output (`a;b;c 42` lines) feeds flamegraph.pl or
+        speedscope directly (runtime/profiler.py FlameProfiler)."""
+        from ..runtime.futures import delay
+        from ..runtime.loop import current_loop
+
+        prof = getattr(current_loop(), "profiler", None)
+        if prof is None:
+            return "ERROR: no run-loop profiler (RUN_LOOP_PROFILER knob off)"
+        if not args:
+            return "ERROR: profile start [hz] | stop [path] | <seconds> [path]"
+        if args[0] == "start":
+            hz = float(args[1]) if len(args) > 1 else None
+            flame = prof.flame_start(hz)
+            return f"sampling loop thread at {flame.hz:g} Hz"
+        if args[0] == "stop":
+            return self._finish_profile(prof, args[1] if len(args) > 1 else None)
+        seconds = float(args[0])
+        prof.flame_start()
+        await delay(seconds)
+        return self._finish_profile(prof, args[1] if len(args) > 1 else None)
+
+    def _finish_profile(self, prof, path) -> str:
+        flame = prof.flame
+        samples = flame.samples if flame is not None else 0
+        folded = prof.flame_stop()
+        if not folded:
+            return "(no samples — was the loop idle, or sampling never started?)"
+        if path:
+            with open(path, "w") as f:
+                f.write(folded + "\n")
+            return (
+                f"wrote {len(folded.splitlines())} folded stacks "
+                f"({samples} samples) to {path}"
+            )
+        return folded
+
     async def _cmd_exclude(self, args) -> str:
         if not args:
             ex = await management.get_excluded(self.db)
@@ -341,6 +433,52 @@ class FdbCli:
             self.db, self.coordinators, self.db.client, **changes
         )
         return "Configuration changed; recovery triggered"
+
+
+def _dedupe_loops(run_loop: dict) -> dict:
+    """loop_id → (address, snapshot). Every sim process reports the ONE
+    loop the whole sim shares; summing those would multiply every counter
+    by the worker count, so consumers aggregate loops, not processes."""
+    loops: dict = {}
+    for addr, snap in sorted(run_loop.items()):
+        if snap:
+            loops.setdefault(snap.get("loop_id") or addr, (addr, snap))
+    return loops
+
+
+def _format_run_loop(run_loop: dict) -> list:
+    """`cli status` lines for the status document's run_loop section:
+    loop totals plus per-priority-band starvation latency (worst observed
+    percentiles across loops — stats.LatencySample.merge)."""
+    from ..runtime.profiler import BAND_ORDER
+    from ..runtime.stats import LatencySample
+
+    loops = _dedupe_loops(run_loop)
+    if not loops:
+        return []
+    steps = sum(s.get("steps") or 0 for _a, s in loops.values())
+    slow = sum(s.get("slow_tasks") or 0 for _a, s in loops.values())
+    worst_addr, worst = max(
+        loops.values(), key=lambda kv: kv[1].get("busy_fraction") or 0.0
+    )
+    lines = [
+        f"Run loop: {len(loops)} loop(s), {steps} steps, {slow} slow tasks, "
+        f"busiest {worst_addr} at {(worst.get('busy_fraction') or 0):.1%} busy"
+    ]
+    for band in BAND_ORDER:
+        merged = LatencySample.merge(
+            [
+                ((s.get("bands") or {}).get(band) or {}).get("starvation")
+                for _a, s in loops.values()
+            ]
+        )
+        if merged["count"]:
+            lines.append(
+                f"  starvation [{band}]: {merged['count']} tasks, worst "
+                f"p95 {merged['p95'] * 1000:.2f} ms, "
+                f"p99 {merged['p99'] * 1000:.2f} ms"
+            )
+    return lines
 
 
 def _run_lint(args: list) -> tuple:

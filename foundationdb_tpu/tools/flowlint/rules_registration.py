@@ -11,7 +11,12 @@ exemption *at the site* instead of in a faraway allowlist dict.
 - ``reg-role-metrics``: every recruitable role class owns a
   ``self.stats = CounterCollection(...)`` and registers a ``*.metrics#``
   endpoint — otherwise its traffic is invisible to status/trace and every
-  bench capture built on them.
+  bench capture built on them. When the config names a
+  ``process_metrics_endpoint`` token, the rule also requires the Worker
+  class itself to register it: the run-loop profiler's per-process
+  snapshot (runtime/profiler.py) is worker-level, not per-role, and a
+  worker that drops the endpoint silently blinds the status document's
+  ``run_loop`` section and ``cli top``.
 - ``reg-endpoint-span``: every RPC endpoint a proxy/storage/resolver
   registers (``process.register(token, self.handler)``) opens a
   distributed-trace span in its handler — or carries an explicit inline
@@ -147,6 +152,22 @@ def _has_metrics_endpoint(cdef: ast.ClassDef) -> bool:
     return False
 
 
+def _registers_token(cdef: ast.ClassDef, token: str) -> bool:
+    """True when the class body contains a ``*.register(<token>, ...)``
+    call with the token as a literal first argument."""
+    for n in ast.walk(cdef):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "register"
+            and n.args
+            and isinstance(n.args[0], ast.Constant)
+            and n.args[0].value == token
+        ):
+            return True
+    return False
+
+
 class RoleMetricsRule(Rule):
     id = "reg-role-metrics"
     title = "every recruitable role class owns CounterCollection + *.metrics#"
@@ -155,6 +176,7 @@ class RoleMetricsRule(Rule):
     def check_project(
         self, modules: dict[str, Module], config: dict
     ) -> Iterator[Finding]:
+        yield from self._check_worker_process_metrics(modules, config)
         for kind, cls_name, home, cdef, unresolved in _role_classes(
             modules, config
         ):
@@ -178,6 +200,36 @@ class RoleMetricsRule(Rule):
                     f"role `{kind}`: {cls_name} registers no `*.metrics#` "
                     f"endpoint — the status aggregator cannot pull it",
                 )
+
+    def _check_worker_process_metrics(
+        self, modules: dict[str, Module], config: dict
+    ) -> Iterator[Finding]:
+        """Worker-level (not per-role) observability: the run-loop
+        profiler endpoint named by config `process_metrics_endpoint` must
+        be registered by the Worker class itself. Config-keyed so
+        synthetic fixture trees without the key opt out."""
+        token = config.get("process_metrics_endpoint")
+        if not token:
+            return
+        worker_rel = config.get(
+            "worker_module", "foundationdb_tpu/server/worker.py"
+        )
+        worker = modules.get(worker_rel)
+        if worker is None:
+            return
+        wcls = _find_class(worker, "Worker")
+        if wcls is not None and _registers_token(wcls, token):
+            return
+        node = wcls or (worker.tree.body[0] if worker.tree.body else worker.tree)
+        yield worker.finding(
+            self.id,
+            node,
+            "worker-process-metrics",
+            f"the Worker never registers the `{token}` endpoint — the "
+            f"run-loop profiler's per-process snapshot (slow tasks, "
+            f"starvation bands, hot actors) would be invisible to the "
+            f"status document's run_loop section and `cli top`",
+        )
 
 
 def _registered_handlers(cdef: ast.ClassDef) -> dict[str, int]:

@@ -94,6 +94,12 @@ def run_sim(args) -> dict:
         report["trace_breakdown"] = critical_path(
             trace_log().events, root_prefix="Client."
         )
+    # run-loop profiler snapshot (runtime/profiler.py): WHO held the loop
+    # during the run, next to the kernel snapshot and trace breakdown —
+    # the before-evidence for loop-starvation claims
+    prof = getattr(sim.loop, "profiler", None)
+    if prof is not None:
+        report["run_loop"] = prof.snapshot(top=5)
     return report
 
 
@@ -159,7 +165,11 @@ def run_tcp_client(args, coordinators) -> dict:
         return True
 
     world.run_until_done(spawn(go()), 36000.0)
-    return w.rec.report()
+    report = w.rec.report()
+    prof = getattr(world.loop, "profiler", None)
+    if prof is not None:
+        report["run_loop"] = prof.snapshot(top=5)
+    return report
 
 
 def run_tcp(args) -> dict:

@@ -6,7 +6,7 @@ periodic ``*Metrics`` CounterCollection events — and read the span layer
 breakdown ("p50 read = client rpc X ms + storage engine Z ms + ...").
 
   python -m foundationdb_tpu.tools.trace_analyze trace.jsonl [more.jsonl ...]
-      [--top N] [--spans] [--trace TRACE_ID] [--json]
+      [--top N] [--spans] [--trace TRACE_ID] [--slow-tasks] [--json]
 
 Multiple trace files merge in time order — a TCP cluster writes one file
 per fdbserver, and a trace's spans scatter across all of them. Rolled
@@ -149,6 +149,74 @@ def format_summary(summary: dict) -> str:
                 f"  {key}: {tl['points']} points over {round(span, 1)}s  "
                 + (" ".join(deltas[:8]) if deltas else "(no movement)")
             )
+    return "\n".join(lines)
+
+
+# -- slow-task mode (run-loop profiler, runtime/profiler.py) -------------------
+
+
+def slow_tasks(events: list[dict], top: int = 10) -> dict:
+    """Aggregate ``Type="SlowTask"`` events (the run-loop profiler's
+    blocking-callback attribution) across the merged multi-file timeline:
+    per-actor count / total / worst busy time, plus which processes and
+    priority bands the stalls hit. The table an operator reads to answer
+    "who blocked the loop, where, and for how long"."""
+    rows: dict[str, dict] = {}
+    total = 0
+    for e in events:
+        if e.get("Type") != "SlowTask":
+            continue
+        total += 1
+        name = e.get("Actor") or "?"
+        r = rows.setdefault(
+            name,
+            {
+                "actor": name,
+                "count": 0,
+                "total_ms": 0.0,
+                "max_ms": 0.0,
+                "bands": set(),
+                "machines": set(),
+            },
+        )
+        r["count"] += 1
+        ms = e.get("BusyMs") or 0.0
+        r["total_ms"] += ms
+        if ms > r["max_ms"]:
+            r["max_ms"] = ms
+        if e.get("Band"):
+            r["bands"].add(str(e["Band"]))
+        if e.get("Machine"):
+            r["machines"].add(str(e["Machine"]))
+    actors = sorted(rows.values(), key=lambda r: (-r["total_ms"], r["actor"]))[:top]
+    return {
+        "events": total,
+        "actors": [
+            {
+                "actor": r["actor"],
+                "count": r["count"],
+                "total_ms": round(r["total_ms"], 3),
+                "max_ms": round(r["max_ms"], 3),
+                "bands": sorted(r["bands"]),
+                "machines": sorted(r["machines"]),
+            }
+            for r in actors
+        ],
+    }
+
+
+def format_slow_tasks(st: dict) -> str:
+    if not st["events"]:
+        return "no SlowTask events (loop never blocked past RUN_LOOP_SLOW_TASK_MS)"
+    lines = [
+        f"{st['events']} SlowTask events; top actors by total loop time held:",
+        f"{'total ms':>10}  {'max ms':>8}  {'count':>6}  actor [bands] @ machines",
+    ]
+    for r in st["actors"]:
+        lines.append(
+            f"{r['total_ms']:10.2f}  {r['max_ms']:8.2f}  {r['count']:6d}  "
+            f"{r['actor']} [{','.join(r['bands'])}] @ {','.join(r['machines'])}"
+        )
     return "\n".join(lines)
 
 
@@ -339,10 +407,23 @@ def main(argv=None) -> int:
         help="span mode: critical-path breakdown (and waterfalls via --trace)",
     )
     ap.add_argument("--trace-id", default=None, help="render one trace's waterfall")
+    ap.add_argument(
+        "--slow-tasks",
+        action="store_true",
+        dest="slow_tasks",
+        help="top-N table of SlowTask events (run-loop blocking attribution)",
+    )
     args = ap.parse_args(argv)
     events = load_events(args.trace)
     if args.trace_id:
         print(format_waterfall(events, args.trace_id))
+        return 0
+    if args.slow_tasks:
+        st = slow_tasks(events, top=args.top)
+        if args.json:
+            print(json.dumps(st, indent=1, default=str))
+        else:
+            print(format_slow_tasks(st))
         return 0
     if args.spans:
         cp = critical_path(events)
