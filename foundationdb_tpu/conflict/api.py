@@ -80,14 +80,31 @@ class ConflictBatch:
         return self._cs.detect_batch(self._transactions, now, new_oldest_version)
 
 
-def new_conflict_set(backend: str = "oracle", **kwargs) -> ConflictSet:
+def new_conflict_set(
+    backend: str = "oracle", fault_injector=None, **kwargs
+) -> ConflictSet:
     """The ``newConflictSet()`` factory seam (ConflictSet.h:28).
 
     ``tpu`` auto-upgrades to the mesh backend when more than one device is
     visible — the cluster resolver then shards its conflict index across
     the whole mesh (key-range partitioning, conflict/sharded.py) with no
     configuration. ``mesh`` / ``tpu1`` force the choice either way.
+
+    ``fault_injector`` (sim-only, conflict/faults.py) wraps the built
+    device backend in a ``FaultInjectingConflictSet`` so chaos runs can
+    inject dispatch errors, hangs, device loss, and compile stalls at this
+    seam; it is ignored for the sync CPU backends (oracle/native), which
+    are the failover *targets*.
     """
+    cs = _build_conflict_set(backend, **kwargs)
+    if fault_injector is not None and hasattr(cs, "detect_many_encoded_async"):
+        from .faults import FaultInjectingConflictSet
+
+        cs = FaultInjectingConflictSet(cs, fault_injector)
+    return cs
+
+
+def _build_conflict_set(backend: str, **kwargs) -> ConflictSet:
     if backend == "oracle":
         from .oracle import OracleConflictSet
 

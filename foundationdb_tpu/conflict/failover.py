@@ -1,0 +1,507 @@
+"""Kernel fault tolerance around the ``newConflictSet()`` seam.
+
+The resolver's MVCC conflict check lives on a device (the paper's bet) —
+and a device is allowed to die. ``GuardedConflictSet`` wraps the backend
+the resolver talks to with:
+
+- a **bounded journal** of committed write conflict ranges inside the MVCC
+  window (``WriteRangeJournal``) — the resolver already computes them, the
+  journal just keeps them replayable;
+- a **health state machine** HEALTHY → DEGRADED → FAILED_OVER →
+  (re-probe) → HEALTHY, with FAILED as the terminal "even the fallback is
+  gone" state (what used to be the resolver's permanent ``_broken``
+  poison);
+- **journal-replay recovery**: a faulted batch is re-resolved on a freshly
+  built backend whose history is reconstructed from the journal. Replay is
+  write-only blind transactions, so the rebuilt history is exactly the
+  committed write set — verdict semantics are preserved with **zero false
+  commits**; reads older than the journal floor turn TOO_OLD, i.e. at
+  worst extra conservative aborts while replaying;
+- **failover** to the ``native`` C++ skip list (or the ``oracle`` as a
+  backstop) after repeated strikes, and **re-promotion** to the device
+  backend once a periodic probe dispatch passes.
+
+Deadline + bounded in-place retry live in the resolver
+(server/resolver.py:_dispatch_collect), which owns the dispatch/collect
+awaits; this module owns what happens when those fail.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..runtime.knobs import Knobs
+from ..runtime.loop import Cancelled, now as loop_now
+from ..runtime.trace import SevError, SevInfo, SevWarn, trace
+from .api import CommitTransaction, new_conflict_set
+from .faults import KernelTimeoutError
+
+HEALTHY = "HEALTHY"
+DEGRADED = "DEGRADED"
+FAILED_OVER = "FAILED_OVER"
+FAILED = "FAILED"
+
+_STATE_ORDER = {HEALTHY: 0, DEGRADED: 1, FAILED_OVER: 2, FAILED: 3}
+
+
+def health_rank(state: str) -> int:
+    """Severity order for status roll-ups (worst state wins)."""
+    return _STATE_ORDER.get(state, 0)
+
+
+class KernelFailedError(RuntimeError):
+    """Conflict kernel AND its fallback are broken — commits cannot be
+    checked on this resolver. The structured (kernel.health=FAILED +
+    SevError trace) replacement for the old opaque ``resolver backend
+    failed`` RuntimeError."""
+
+
+class WriteRangeJournal:
+    """Bounded, version-ordered journal of committed write conflict ranges
+    inside the MVCC window. ``floor`` is the first version whose committed
+    history is fully journaled: replay onto a backend cleared at ``floor``
+    reconstructs verdict-equivalent history for every snapshot >= floor,
+    while older snapshots become TOO_OLD (a conservative abort, never a
+    false commit)."""
+
+    def __init__(self, capacity: int, floor: int = 0):
+        self.capacity = max(int(capacity), 1)
+        self.entries: deque = deque()  # (version, [(begin, end), ...]) ascending
+        self.floor = floor
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def record(self, version: int, ranges: list) -> None:
+        if ranges:
+            self.entries.append((version, list(ranges)))
+        while len(self.entries) > self.capacity:
+            v, _ = self.entries.popleft()
+            self.floor = max(self.floor, v + 1)
+            self.dropped += 1
+
+    def trim_below(self, version: int) -> None:
+        """MVCC GC: snapshots below ``version`` are TOO_OLD on any backend,
+        so their history can never flip a verdict."""
+        while self.entries and self.entries[0][0] < version:
+            self.entries.popleft()
+        self.floor = max(self.floor, version)
+
+    def reset(self, floor: int) -> None:
+        self.entries.clear()
+        self.floor = floor
+
+    def head_version(self) -> int:
+        return self.entries[-1][0] if self.entries else self.floor
+
+    def replay_into(self, cs) -> None:
+        """Reconstruct history on a fresh backend: blind write-only txns
+        always commit, so the backend ends with exactly the journaled
+        committed writes at their original versions."""
+        cs.clear(self.floor)
+        work = [
+            ([CommitTransaction(write_conflict_ranges=list(ranges))], v, 0)
+            for v, ranges in self.entries
+        ]
+        if not work:
+            return
+        if hasattr(cs, "detect_many"):
+            cs.detect_many(work)  # one device dispatch for the whole replay
+        else:
+            for txns, v, old in work:
+                cs.detect_batch(txns, now=v, new_oldest_version=old)
+
+
+class _GuardMetrics:
+    """``resolver.metrics`` → ``kernel`` section: the inner device
+    KernelMetrics snapshot (occupancy, replays, transfer bytes, …) merged
+    with the guard's ``health`` subsection, so status/cli/bench consumers
+    keep one well-known place to look."""
+
+    def __init__(self, guard: "GuardedConflictSet"):
+        self._guard = guard
+
+    def snapshot(self) -> dict:
+        inner = getattr(self._guard.primary, "metrics", None)
+        out = inner.snapshot() if inner is not None else {}
+        out["health"] = self._guard.health_snapshot()
+        return out
+
+
+class GuardedConflictSet:
+    """The conflict set the resolver actually holds. Delegates to the
+    current backend (device while healthy, native/oracle after failover)
+    and owns journal + health + recovery. The async-dispatch protocol is
+    emulated over sync fallbacks so the resolver's pipelined path keeps
+    working across a failover."""
+
+    def __init__(
+        self,
+        backend: str,
+        knobs: Knobs = None,
+        uid: str = "",
+        fault_injector=None,
+        **backend_kw,
+    ):
+        self.knobs = knobs or Knobs()
+        self.kind = backend
+        self.uid = uid
+        self._kw = dict(backend_kw)
+        self._injector = fault_injector
+        self.journal = WriteRangeJournal(self.knobs.CONFLICT_JOURNAL_CAPACITY)
+        self.health = HEALTHY
+        self.last_error = ""
+        self._strikes = 0
+        self._gen = 0  # bumped on every backend swap (stale-encoding fence)
+        self._last_probe = None
+        # health counters (surfaced via health_snapshot → kernel.health)
+        self.c_faults = 0
+        self.c_retries = 0
+        self.c_deadline_hits = 0
+        self.c_rebuilds = 0
+        self.c_failovers = 0
+        self.c_reprobes = 0
+        self.c_probe_failures = 0
+        self.c_promotions = 0
+        self.c_journal_replays = 0
+        self.metrics = _GuardMetrics(self)
+        self._cs = None  # set below; _note_fault may run before it exists
+        try:
+            self._cs = self._build_primary()
+        except Cancelled:
+            raise
+        except BaseException as e:
+            # device dead at boot (lost tunnel): start failed over rather
+            # than refuse the role — the journal is empty, so the fallback
+            # is exactly equivalent
+            self._note_fault(e)
+            self._failover()
+        self.pipelined = hasattr(self._cs, "detect_many_encoded_async") or (
+            self.health == FAILED_OVER and backend in ("tpu", "tpu1", "mesh")
+        )
+
+    # -- backend construction / swap ------------------------------------------
+
+    @property
+    def primary(self):
+        """The current backend, unwrapped of the fault injector (for
+        isinstance checks and metrics access)."""
+        return getattr(self._cs, "inner", self._cs)
+
+    @property
+    def backend_name(self) -> str:
+        return type(self.primary).__name__ if self._cs is not None else "none"
+
+    @property
+    def failed(self) -> bool:
+        return self.health == FAILED
+
+    def _build_primary(self):
+        return new_conflict_set(
+            self.kind, fault_injector=self._injector, **self._kw
+        )
+
+    def _swap(self, cs, health: str) -> None:
+        self._cs = cs
+        self._gen += 1
+        self.health = health
+        if health == HEALTHY:
+            self.last_error = ""
+
+    def _note_fault(self, err) -> None:
+        self.c_faults += 1
+        self._strikes += 1
+        if isinstance(err, KernelTimeoutError) and "recovery" in str(err):
+            # sync-path hang (no resolver deadline wait counted it)
+            self.c_deadline_hits += 1
+        self.last_error = repr(err)
+        if self.health == HEALTHY:
+            self.health = DEGRADED
+        trace(
+            SevWarn,
+            "KernelFault",
+            "",
+            Resolver=self.uid,
+            Backend=self.backend_name,
+            Strikes=self._strikes,
+            Health=self.health,
+            Err=repr(err),
+        )
+
+    def note_retry(self) -> None:
+        self.c_retries += 1
+        if self.health == HEALTHY:
+            self.health = DEGRADED
+
+    def note_deadline(self) -> None:
+        self.c_deadline_hits += 1
+
+    def note_ok(self) -> None:
+        """A batch completed through the normal device path: strikes reset
+        and a DEGRADED kernel is healthy again."""
+        self._strikes = 0
+        if self.health == DEGRADED:
+            self.health = HEALTHY
+            self.last_error = ""
+
+    def _hard_fail(self, err) -> None:
+        self.health = FAILED
+        self.last_error = repr(err)
+        trace(
+            SevError,
+            "KernelFailed",
+            "",
+            Resolver=self.uid,
+            Err=repr(err),
+        )
+
+    # -- journal ---------------------------------------------------------------
+
+    def record_committed(self, version: int, ranges: list, oldest: int) -> None:
+        """Called once per resolved batch, in version order (the resolver's
+        gates guarantee it): journal this batch's committed write ranges
+        and GC the journal to the MVCC window."""
+        self.journal.record(version, ranges)
+        if oldest > 0:
+            self.journal.trim_below(oldest)
+
+    def _replayed(self, cs):
+        self.journal.replay_into(cs)
+        self.c_journal_replays += 1
+        return cs
+
+    def _check_stall(self, cs) -> None:
+        """Sync paths can't await an injected stall: a finite stall is just
+        latency (ignore), an infinite one is the hang fault."""
+        take = getattr(cs, "take_stall", None)
+        stall = take() if take is not None else None
+        if stall == float("inf"):
+            raise KernelTimeoutError("injected hang during recovery dispatch")
+
+    # -- recovery / failover / re-promotion -------------------------------------
+
+    def recover_resolve(self, transactions, version, new_oldest, err=None):
+        """The device path failed for this batch (deadline, device loss,
+        exhausted retries, arbitrary backend exception): re-resolve it on a
+        backend rebuilt from the journal. Strikes escalate to failover; if
+        even the fallback fails, health=FAILED and KernelFailedError raises
+        (typed, SevError-traced — never an opaque RuntimeError)."""
+        if err is not None:
+            self._note_fault(err)
+        if self.health not in (FAILED_OVER, FAILED):
+            attempts = self.knobs.CONFLICT_REBUILD_ATTEMPTS
+            for _attempt in range(attempts):
+                if self._strikes >= self.knobs.CONFLICT_FAILOVER_STRIKES:
+                    break
+                try:
+                    cs = self._replayed(self._build_primary())
+                    verdicts = cs.detect_batch(
+                        transactions, now=version, new_oldest_version=new_oldest
+                    )
+                    self._check_stall(cs)
+                except Cancelled:
+                    raise
+                except BaseException as e:
+                    self._note_fault(e)
+                    continue
+                self.c_rebuilds += 1
+                self._swap(cs, DEGRADED)  # healthy again after a clean batch
+                trace(
+                    SevInfo,
+                    "KernelRebuilt",
+                    "",
+                    Resolver=self.uid,
+                    Version=version,
+                    JournalDepth=len(self.journal),
+                )
+                return verdicts
+        if self.health != FAILED_OVER:
+            self._failover()
+        try:
+            return self._cs.detect_batch(
+                transactions, now=version, new_oldest_version=new_oldest
+            )
+        except Cancelled:
+            raise
+        except BaseException as e:
+            self._hard_fail(e)
+            raise KernelFailedError(
+                f"conflict kernel and fallback both failed: {e!r}"
+            ) from e
+
+    def _failover(self) -> None:
+        """Construct the fallback (native skip list, oracle as backstop),
+        replay the journal so verdict semantics carry over, and flip the
+        state machine to FAILED_OVER."""
+        for kind in ("native", "oracle"):
+            try:
+                cs = self._replayed(new_conflict_set(kind))
+            except Cancelled:
+                raise
+            except BaseException:
+                continue  # no native toolchain → oracle backstop
+            self._swap(cs, FAILED_OVER)
+            self.c_failovers += 1
+            self._last_probe = loop_now()
+            trace(
+                SevWarn,
+                "KernelFailover",
+                "",
+                Resolver=self.uid,
+                Fallback=type(cs).__name__,
+                JournalDepth=len(self.journal),
+                JournalFloor=self.journal.floor,
+            )
+            return
+        err = RuntimeError("no fallback conflict backend could be built")
+        self._hard_fail(err)
+        raise KernelFailedError(str(err))
+
+    def _maybe_promote(self) -> None:
+        """While failed over: periodically rebuild the device backend from
+        the journal and smoke-probe it; on success the device takes back
+        over (HEALTHY)."""
+        if self.health != FAILED_OVER:
+            return
+        t = loop_now()
+        if (
+            self._last_probe is not None
+            and t - self._last_probe < self.knobs.CONFLICT_REPROBE_INTERVAL
+        ):
+            return
+        self._last_probe = t
+        self.c_reprobes += 1
+        try:
+            cs = self._replayed(self._build_primary())
+            cs.detect_batch(
+                [], now=self.journal.head_version(), new_oldest_version=0
+            )
+            self._check_stall(cs)
+        except Cancelled:
+            raise
+        except BaseException as e:
+            self.c_probe_failures += 1
+            self.last_error = repr(e)
+            return
+        self._swap(cs, HEALTHY)
+        self._strikes = 0
+        self.c_promotions += 1
+        trace(
+            SevInfo,
+            "KernelPromoted",
+            "",
+            Resolver=self.uid,
+            Backend=self.backend_name,
+            JournalDepth=len(self.journal),
+        )
+
+    # -- ConflictSet protocol (delegation + async emulation) ---------------------
+
+    @property
+    def oldest_version(self) -> int:
+        return self._cs.oldest_version
+
+    def warm_compile(self) -> None:
+        fn = getattr(self._cs, "warm_compile", None)
+        if fn is None:
+            return
+        try:
+            fn()
+        except Cancelled:
+            raise
+        except Exception as e:
+            # warm compile is an optimization, never a boot failure
+            trace(SevWarn, "KernelWarmCompileFailed", "", Resolver=self.uid, Err=repr(e))
+
+    def take_stall(self):
+        take = getattr(self._cs, "take_stall", None)
+        return take() if take is not None else None
+
+    def clear(self, version: int) -> None:
+        self.journal.reset(version)
+        try:
+            self._cs.clear(version)
+        except Cancelled:
+            raise
+        except BaseException as e:
+            self._note_fault(e)
+            # the journal is empty at `version`: the fallback (or a later
+            # promoted device) starts from exactly the cleared state
+            self._failover()
+
+    def prepare(self, now_version: int) -> None:
+        fn = getattr(self._cs, "prepare", None)
+        if fn is not None:
+            fn(now_version)
+
+    def encode(self, transactions):
+        """Generation-stamped encoding: a backend swap between encode and
+        dispatch surfaces as a transient fault (the resolver re-encodes)."""
+        fn = getattr(self._cs, "encode", None)
+        payload = fn(transactions) if fn is not None else list(transactions)
+        return (self._gen, payload)
+
+    def detect_many_encoded_async(self, work):
+        from .faults import KernelTransientError
+
+        self._maybe_promote()
+        cs = self._cs
+        for (gen, _payload), _v, _old in work:
+            if gen != self._gen:
+                raise KernelTransientError(
+                    "stale encoding: backend swapped after encode()"
+                )
+        if hasattr(cs, "detect_many_encoded_async"):
+            return cs.detect_many_encoded_async(
+                [(payload, v, old) for (_g, payload), v, old in work]
+            )
+        # sync emulation over the fallback: resolve now, hand back a thunk
+        # (the resolver's pipelined path keeps one shape across failover)
+        outs = [
+            cs.detect_batch(payload, now=v, new_oldest_version=old)
+            for (_g, payload), v, old in work
+        ]
+        return lambda: outs
+
+    def detect_batch(self, transactions, now, new_oldest_version):
+        """The resolver's non-pipelined path (and recovery re-resolves):
+        guarded so a backend error degrades instead of poisoning."""
+        if self.failed:
+            raise KernelFailedError(f"conflict kernel failed: {self.last_error}")
+        self._maybe_promote()
+        try:
+            verdicts = self._cs.detect_batch(
+                transactions, now=now, new_oldest_version=new_oldest_version
+            )
+            self._check_stall(self._cs)
+            return verdicts
+        except Cancelled:
+            raise
+        except KernelFailedError:
+            raise
+        except BaseException as e:
+            return self.recover_resolve(
+                transactions, now, new_oldest_version, err=e
+            )
+
+    # -- observability -----------------------------------------------------------
+
+    def health_snapshot(self) -> dict:
+        return {
+            "state": self.health,
+            "backend": self.backend_name,
+            "strikes": self._strikes,
+            "faults": self.c_faults,
+            "retries": self.c_retries,
+            "deadlineHits": self.c_deadline_hits,
+            "deviceRebuilds": self.c_rebuilds,
+            "failovers": self.c_failovers,
+            "reprobes": self.c_reprobes,
+            "probeFailures": self.c_probe_failures,
+            "promotions": self.c_promotions,
+            "journalDepth": len(self.journal),
+            "journalFloor": self.journal.floor,
+            "journalReplays": self.c_journal_replays,
+            "lastError": self.last_error,
+        }

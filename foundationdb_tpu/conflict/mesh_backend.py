@@ -129,6 +129,21 @@ class MeshConflictSet(ConflictSet):
 
     # -- ConflictSet interface ------------------------------------------------
 
+    def warm_compile(self) -> None:
+        """Pre-compile the sharded resolver step for the smoke shape
+        (T=8, KR=KW=1) on scratch states — same first-commit-batch
+        de-stall as TpuConflictSet.warm_compile, against the mesh's
+        pjit'd step function."""
+        t0 = time.perf_counter()
+        scratch = self._fresh_states()
+        b = encode_transactions([], self._width, 0)
+        z = np.int32(0)
+        out = self._step(scratch, b, np.int32(1), z, z)
+        self._jax.block_until_ready(out)
+        self.metrics.note_shape((b.rb.shape[0], b.rb.shape[1], b.wb.shape[1]))
+        self.metrics.warm_compiles.add()
+        self.metrics.warm_s.add(time.perf_counter() - t0)
+
     def clear(self, version: int) -> None:
         self._flush()
         self._states = self._fresh_states()

@@ -50,3 +50,12 @@ def buggify(site: Optional[tuple] = None) -> bool:
     # _depth=2: attribute the site to the caller of this wrapper, not the
     # wrapper itself — otherwise every call site collapses to one key.
     return _buggify(site, _depth=2)
+
+
+def mark_fired(site: tuple) -> None:
+    """Record an externally-decided chaos event (e.g. the kernel fault
+    injector's own seeded-RNG rolls, conflict/faults.py) in this run's
+    buggify coverage, so the soak's fired-site report sees every fault
+    source — not only the buggify()-gated ones. No-op outside simulation."""
+    if _buggify.rng is not None:
+        _buggify.fired.add(site)

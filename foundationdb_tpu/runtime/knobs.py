@@ -33,6 +33,20 @@ class Knobs:
     # conflict set
     CONFLICT_SET_BACKEND = "tpu"  # tpu | native | oracle (newConflictSet knob)
     CONFLICT_SET_CAPACITY = 1 << 14
+    # conflict-kernel fault tolerance (conflict/failover.py + resolver):
+    # per-batch deadline on the device dispatch/collect path, bounded
+    # in-place retry for transient faults, then journal-replay recovery
+    # escalating to failover onto the native/oracle backend
+    CONFLICT_DISPATCH_DEADLINE = 2.0  # s per batch before the device is presumed wedged
+    CONFLICT_DISPATCH_RETRIES = 3  # in-place dispatch retries (transient errors)
+    CONFLICT_RETRY_BACKOFF = 0.02  # base retry backoff (s, doubles per attempt)
+    CONFLICT_FAILOVER_STRIKES = 3  # recovery resolves before failing over
+    CONFLICT_REBUILD_ATTEMPTS = 2  # device rebuild tries per recovery resolve
+    CONFLICT_REPROBE_INTERVAL = 1.0  # probe cadence for device re-promotion (s)
+    CONFLICT_JOURNAL_CAPACITY = 512  # journaled committed-write batches kept
+    # sim-only seeded device-fault injection at the conflict seam
+    # (conflict/faults.py): dispatch errors, hangs, device loss, stalls
+    CONFLICT_FAULT_INJECTION = False
     # storage
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_WAIT_VERSION_TIMEOUT = 1.0  # then future_version (client retries)
@@ -188,6 +202,14 @@ class Knobs:
             self.LATENCY_PROBE_INTERVAL = rng.random_choice([0.5, 1.0, 5.0])
         if rng.coinflip(0.25):
             self.METRICS_TRACE_INTERVAL = rng.random_choice([1.0, 5.0, 10.0])
+        if rng.coinflip(0.25):
+            self.CONFLICT_DISPATCH_DEADLINE = rng.random_choice([0.5, 2.0, 5.0])
+        if rng.coinflip(0.25):
+            self.CONFLICT_FAILOVER_STRIKES = rng.random_choice([2, 3, 5])
+        if rng.coinflip(0.25):
+            self.CONFLICT_REPROBE_INTERVAL = rng.random_choice([0.3, 1.0, 3.0])
+        if rng.coinflip(0.25):
+            self.CONFLICT_JOURNAL_CAPACITY = rng.random_choice([64, 512, 2048])
         # coupled constraint: a proxy must keep waiting for a version
         # grant at least as long as the master might legitimately park it
         # behind a gap, or slow-but-honored grants get double-assigned

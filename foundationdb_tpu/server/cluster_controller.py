@@ -536,6 +536,34 @@ class ClusterController:
                     # (occupancy / overflow replays / transfer bytes)
                     resolvers[uid] = dict(snap, address=addr)
         doc["resolvers"] = resolvers
+        # conflict-kernel health roll-up (worst state wins): failover is an
+        # operator-page event, so it surfaces at the top level instead of
+        # only inside per-resolver kernel sections
+        from ..conflict.failover import health_rank
+
+        kernel = {
+            "state": "HEALTHY",
+            "failovers": 0,
+            "retries": 0,
+            "deadline_hits": 0,
+            "promotions": 0,
+            "device_rebuilds": 0,
+        }
+        saw_kernel = False
+        for snap in resolvers.values():
+            h = (snap.get("kernel") or {}).get("health") or {}
+            if not h:
+                continue
+            saw_kernel = True
+            if health_rank(h.get("state")) > health_rank(kernel["state"]):
+                kernel["state"] = h.get("state")
+            kernel["failovers"] += h.get("failovers") or 0
+            kernel["retries"] += h.get("retries") or 0
+            kernel["deadline_hits"] += h.get("deadlineHits") or 0
+            kernel["promotions"] += h.get("promotions") or 0
+            kernel["device_rebuilds"] += h.get("deviceRebuilds") or 0
+        if saw_kernel:
+            doc["kernel"] = kernel
         if committed:
             doc["data"] = {
                 "max_storage_version": max(committed),

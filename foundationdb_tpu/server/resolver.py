@@ -19,14 +19,18 @@ The conflict check itself is the pluggable ConflictSet seam
 
 from __future__ import annotations
 
-from ..conflict.api import CommitTransaction, Verdict, new_conflict_set
+from ..conflict.api import CommitTransaction, Verdict
+from ..conflict.failover import GuardedConflictSet, KernelFailedError
+from ..conflict.faults import KernelFaultError, KernelTimeoutError
 from ..runtime.futures import Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.stats import CounterCollection
-from ..runtime.trace import emit_span, span
+from ..runtime.trace import SevWarn, emit_span, span, trace
 from .interfaces import ResolveBatchReply, ResolveBatchRequest, Tokens, Version
+
+_TIMED_OUT = object()  # timeout() sentinel (None is a legal future value)
 
 
 class _SerialExecutor:
@@ -94,7 +98,24 @@ class Resolver:
             # knob existed but never reached the backend — randomized sim
             # runs silently tested the default capacity only)
             backend_kw["capacity"] = self.knobs.CONFLICT_SET_CAPACITY
-        self.cs = new_conflict_set(backend, **backend_kw)
+        # device-fault injection (sim-only): seeded from the sim loop's RNG
+        # under the CONFLICT_FAULT_INJECTION knob; chaos soaks arm the
+        # named kernel-fault buggify sites through it (conflict/faults.py)
+        injector = backend_kw.pop("fault_injector", None)
+        if injector is None and backend in ("tpu", "tpu1", "mesh"):
+            injector = self._make_injector()
+        # every backend rides behind the fault-tolerance guard
+        # (conflict/failover.py): bounded journal of committed write
+        # ranges, HEALTHY→DEGRADED→FAILED_OVER→HEALTHY state machine, and
+        # journal-replay failover to native/oracle — the `_broken`
+        # permanent-poison path is gone
+        self.cs = GuardedConflictSet(
+            backend,
+            knobs=self.knobs,
+            uid=uid,
+            fault_injector=injector,
+            **backend_kw,
+        )
         if first_version:
             # a post-recovery resolver starts with empty history at the
             # recovery version: snapshots older than it must be TOO_OLD
@@ -107,11 +128,10 @@ class Resolver:
         # flight — the device threads the history state, so dispatch order
         # alone fixes the outcome. Post-collect bookkeeping (reply cache,
         # state-txn echoes) still runs in version order via reply_gate.
-        self._pipelined = hasattr(self.cs, "detect_many_encoded_async")
+        self._pipelined = self.cs.pipelined
         self.reply_gate = VersionGate(first_version)
         self.uid = uid
         self._exec: _SerialExecutor = None  # created lazily on a RealLoop
-        self._broken: BaseException = None  # conflict backend failed fatally
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
         # version → [(committed, mutations)] for system-keyspace txns —
@@ -131,12 +151,22 @@ class Resolver:
         self.stats.gauge("version", lambda: self.gate.version)
         # device-kernel observability: the TPU/mesh backends carry a
         # KernelMetrics CounterCollection (per-phase wall time, overflow
-        # replays, reshard/transfer counters, occupancy). Snapshot it as a
-        # nested section so resolver.metrics / the status document / the
-        # periodic ResolverMetrics trace all carry it with no extra wiring.
-        kernel = getattr(self.cs, "metrics", None)
-        if kernel is not None:
-            self.stats.gauge("kernel", kernel.snapshot)
+        # replays, reshard/transfer counters, occupancy); the guard adds a
+        # `health` subsection (state machine, failover/retry/deadline
+        # counters, journal depth). Snapshot it as a nested section so
+        # resolver.metrics / the status document / the periodic
+        # ResolverMetrics trace all carry it with no extra wiring.
+        self.stats.gauge("kernel", self.cs.metrics.snapshot)
+        # pre-compile the smoke-shape kernel at construction (on the
+        # device thread when one exists) so the first real commit batch is
+        # a jit-cache hit instead of the first-compile stall the run-loop
+        # profiler attributed to the resolver band (PR 9 evidence)
+        self._warm: Future = None
+        if self._pipelined:
+            try:
+                self._warm = self._submit(self.cs.warm_compile)
+            except RuntimeError:  # no active loop (direct tool use)
+                self.cs.warm_compile()
         # per-range load sample for resolutionBalancing
         # (Resolver.actor.cpp:276-284 iopsSample): conflict-range begin
         # keys → op counts, decayed by halving at the cap; cumulative op
@@ -216,60 +246,33 @@ class Resolver:
         window = self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS
         oldest = max(0, req.version - window)
         if self._pipelined:
-            if self._broken is not None:
-                # a prior batch wedged/corrupted the device state: fail
-                # fast so recovery replaces this resolver instead of every
-                # proxy waiting on a gate that will never open. Both gates
-                # still advance, or the NEXT batch in the version chain
-                # would block forever at wait_until instead of failing too.
+            if self.cs.failed:
+                # the kernel AND its fallback are gone (kernel.health =
+                # FAILED, SevError already traced by the guard): fail fast
+                # with a typed error so recovery replaces this resolver.
+                # Both gates still advance, or the NEXT batch in the
+                # version chain would block forever at wait_until.
                 self.gate.advance_to(req.version)
                 self.reply_gate.advance_to(req.version)
-                raise RuntimeError(f"resolver backend failed: {self._broken!r}")
-
-            def dispatch(txns=txns, version=req.version, oldest=oldest):
-                self.cs.prepare(version)  # version-base rebase window
-                enc = self.cs.encode(txns)
-                return self.cs.detect_many_encoded_async([(enc, version, oldest)])
-
-            # all conflict-set work runs on one serial executor (RealLoop)
-            # or inline (sim): dispatch jobs enqueue in gate order here,
-            # collect jobs interleave behind later dispatches — so the
-            # device pipelines across batches while the loop never blocks
-            # on a device wait (a first-shape compile can outlast
-            # FAILURE_TIMEOUT and flap the whole worker otherwise)
-            dfut = self._submit(dispatch)
-            # the device now owns the (prev → version) ordering for this
-            # batch: open the gate and yield so the next batch in the
-            # chain dispatches before we block on this one's verdicts
-            # (the phase overlap of MasterProxyServer.actor.cpp:353,
-            # applied at the resolver↔device boundary)
-            self.gate.advance_to(req.version)
-            await delay(0)
+                raise KernelFailedError(
+                    f"conflict kernel failed: {self.cs.last_error}"
+                )
             try:
-                handle = await dfut
-                if rsp.sampled:
-                    # kernel phases as child spans: dispatch (encode +
-                    # device enqueue) vs collect (verdict readback) — the
-                    # same split KernelMetrics samples in aggregate
-                    emit_span(
-                        "Resolver.kernelDispatch", self._proc_addr(), rsp,
-                        t_resolve, now(), backend=type(self.cs).__name__,
-                    )
-                t_collect = now()
-                verdicts = (await self._submit(handle))[0]
-                if rsp.sampled:
-                    emit_span(
-                        "Resolver.kernelCollect", self._proc_addr(), rsp,
-                        t_collect, now(),
-                    )
+                verdicts = await self._dispatch_collect(
+                    req, txns, oldest, rsp, t_resolve
+                )
                 await self.reply_gate.wait_until(req.prev_version)
-            except BaseException as e:
-                # reply_gate must advance even on failure, or retransmit
-                # waiters (and every later batch) hang forever instead of
-                # seeing this resolver die and recovery replacing it
-                self._broken = e
+                self.cs.note_ok()
+            except Cancelled:
+                # the actor is dying, not the batch: still release both
+                # gates so the version chain never wedges behind a corpse
+                self.gate.advance_to(req.version)
                 self.reply_gate.advance_to(req.version)
                 raise
+            except BaseException as e:
+                verdicts = await self._recover_resolve(
+                    req, txns, oldest, rsp, e
+                )
         else:
             verdicts = self.cs.detect_batch(
                 txns, now=req.version, new_oldest_version=oldest
@@ -277,8 +280,17 @@ class Resolver:
             if rsp.sampled:
                 emit_span(
                     "Resolver.detect", self._proc_addr(), rsp,
-                    t_resolve, now(), backend=type(self.cs).__name__,
+                    t_resolve, now(), backend=self.cs.backend_name,
                 )
+        # journal this batch's committed write ranges (version order: the
+        # pipelined path reaches here only after reply_gate.wait_until, the
+        # sync path is gate-ordered end to end) — the failover layer's
+        # replay source (conflict/failover.py)
+        committed_ranges = []
+        for t, v in zip(req.transactions, verdicts):
+            if int(v) == int(Verdict.COMMITTED):
+                committed_ranges.extend(t.write_conflict_ranges)
+        self.cs.record_committed(req.version, committed_ranges, oldest)
         self._l_resolve.add(now() - t_resolve)
         self._b_resolve.add(now() - t_total)
 
@@ -342,6 +354,162 @@ class Resolver:
         if self._exec is None:
             self._exec = _SerialExecutor()
         return self._exec.submit(fn, loop)
+
+    def _make_injector(self):
+        """Sim-only seeded kernel-fault injector (conflict/faults.py) when
+        the CONFLICT_FAULT_INJECTION knob is on."""
+        if not self.knobs.CONFLICT_FAULT_INJECTION:
+            return None
+        from ..runtime.loop import RealLoop, current_loop
+
+        try:
+            loop = current_loop()
+        except RuntimeError:
+            return None
+        if isinstance(loop, RealLoop) or getattr(loop, "random", None) is None:
+            return None  # never inject faults outside simulation
+        from ..conflict.faults import KernelFaultInjector
+
+        return KernelFaultInjector(loop.random.fork())
+
+    async def _deadline_wait(self, fut: Future, deadline: float):
+        """Await ``fut`` under the batch's dispatch deadline; a miss
+        abandons the (possibly wedged) device executor and raises
+        KernelTimeoutError into the recovery path."""
+        budget = deadline - now()
+        timed_out = _TIMED_OUT
+        if budget > 0:
+            from ..runtime.futures import timeout
+
+            r = await timeout(fut, budget, default=timed_out)
+        else:
+            r = timed_out
+        if r is timed_out:
+            self.cs.note_deadline()
+            self._abandon_executor()
+            raise KernelTimeoutError(
+                "conflict dispatch deadline "
+                f"({self.knobs.CONFLICT_DISPATCH_DEADLINE}s) exceeded"
+            )
+        return r
+
+    def _abandon_executor(self) -> None:
+        """A wedged device call may hold the serial executor's thread
+        forever: drop it (daemon thread) and lazily build a fresh one, so
+        recovery and later batches never queue behind the hang."""
+        if self._exec is not None:
+            ex, self._exec = self._exec, None
+            ex.stop()  # parks a stop marker BEHIND the wedged job: harmless
+
+    async def _dispatch_collect(self, req, txns, oldest, rsp, t_resolve):
+        """Device dispatch/collect with a per-batch deadline
+        (CONFLICT_DISPATCH_DEADLINE) and bounded in-place retry with
+        backoff for transient faults. Retries happen BEFORE the gate
+        advances, so no later batch has dispatched and version order is
+        preserved; everything past the retry budget raises into
+        _recover_resolve."""
+        knobs = self.knobs
+        deadline = now() + knobs.CONFLICT_DISPATCH_DEADLINE
+
+        def dispatch(txns=txns, version=req.version, oldest=oldest):
+            self.cs.prepare(version)  # version-base rebase window
+            enc = self.cs.encode(txns)
+            return self.cs.detect_many_encoded_async([(enc, version, oldest)])
+
+        # all conflict-set work runs on one serial executor (RealLoop)
+        # or inline (sim): dispatch jobs enqueue in gate order here,
+        # collect jobs interleave behind later dispatches — so the
+        # device pipelines across batches while the loop never blocks
+        # on a device wait (a first-shape compile can outlast
+        # FAILURE_TIMEOUT and flap the whole worker otherwise)
+        attempt = 0
+        while True:
+            t_attempt = now()
+            try:
+                handle = await self._deadline_wait(
+                    self._submit(dispatch), deadline
+                )
+                break
+            except Cancelled:
+                raise
+            except KernelFaultError as e:
+                if not e.transient or attempt >= knobs.CONFLICT_DISPATCH_RETRIES:
+                    raise
+                attempt += 1
+                self.cs.note_retry()
+                trace(
+                    SevWarn, "KernelDispatchRetry", self._proc_addr(),
+                    Resolver=self.uid, Attempt=attempt, Err=repr(e),
+                )
+                if rsp.sampled:
+                    emit_span(
+                        "Resolver.kernelRetry", self._proc_addr(), rsp,
+                        t_attempt, now(), attempt=attempt,
+                        err=type(e).__name__,
+                    )
+                # bounded exponential backoff before the next attempt
+                await delay(knobs.CONFLICT_RETRY_BACKOFF * (1 << (attempt - 1)))
+        # the device now owns the (prev → version) ordering for this
+        # batch: open the gate and yield so the next batch in the
+        # chain dispatches before we block on this one's verdicts
+        # (the phase overlap of MasterProxyServer.actor.cpp:353,
+        # applied at the resolver↔device boundary)
+        self.gate.advance_to(req.version)
+        await delay(0)
+        stall = self.cs.take_stall()
+        if stall:
+            # injected device stall (sim): the dispatch completes late —
+            # or, for a hang, never — and the deadline decides which
+            waiter = Future() if stall == float("inf") else delay(stall)
+            await self._deadline_wait(waiter, deadline)
+        if rsp.sampled:
+            # kernel phases as child spans: dispatch (encode +
+            # device enqueue) vs collect (verdict readback) — the
+            # same split KernelMetrics samples in aggregate
+            emit_span(
+                "Resolver.kernelDispatch", self._proc_addr(), rsp,
+                t_resolve, now(), backend=self.cs.backend_name,
+                attempts=attempt + 1,
+            )
+        t_collect = now()
+        verdicts = (await self._deadline_wait(self._submit(handle), deadline))[0]
+        if rsp.sampled:
+            emit_span(
+                "Resolver.kernelCollect", self._proc_addr(), rsp,
+                t_collect, now(),
+            )
+        return verdicts
+
+    async def _recover_resolve(self, req, txns, oldest, rsp, err):
+        """The device path failed for this batch: serialize recovery in
+        version order (earlier batches journal their committed writes
+        first), then re-resolve on a journal-rebuilt backend — failing
+        over to native/oracle after repeated strikes
+        (conflict/failover.py). Both gates always advance: a broken
+        kernel degrades, it never wedges the version chain."""
+        self.gate.advance_to(req.version)  # dispatch may have died pre-advance
+        await self.reply_gate.wait_until(req.prev_version)
+        t0 = now()
+        try:
+            verdicts = self.cs.recover_resolve(
+                txns, req.version, oldest, err=err
+            )
+        except Cancelled:
+            self.reply_gate.advance_to(req.version)
+            raise
+        except BaseException:
+            # reply_gate must advance even on failure, or retransmit
+            # waiters (and every later batch) hang forever instead of
+            # seeing this resolver die and recovery replacing it
+            self.reply_gate.advance_to(req.version)
+            raise
+        if rsp.sampled:
+            emit_span(
+                "Resolver.kernelRecover", self._proc_addr(), rsp,
+                t0, now(), backend=self.cs.backend_name,
+                health=self.cs.health,
+            )
+        return verdicts
 
     def close(self) -> None:
         """Retire the role (worker._destroy): stop the device thread."""

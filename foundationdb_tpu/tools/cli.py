@@ -152,6 +152,16 @@ class FdbCli:
                     f"Latency bands [{leg}] ({b['count']} reqs): "
                     + ", ".join(parts)
                 )
+        kern = doc.get("kernel") or {}
+        if kern:
+            lines.append(
+                f"Conflict kernel: {kern.get('state', '?')}"
+                f" ({kern.get('failovers', 0)} failovers, "
+                f"{kern.get('device_rebuilds', 0)} rebuilds, "
+                f"{kern.get('retries', 0)} retries, "
+                f"{kern.get('deadline_hits', 0)} deadline hits, "
+                f"{kern.get('promotions', 0)} promotions)"
+            )
         qos = doc.get("qos") or {}
         if qos:
             rate = qos.get("released_transactions_per_second")
@@ -206,15 +216,24 @@ class FdbCli:
                 for uid, snap in sorted(resolvers.items()):
                     k = snap.get("kernel") or {}
                     occ = (k.get("occupancy") or {}) if k else {}
+                    h = (k.get("health") or {}) if k else {}
                     extra = (
                         f"  kernel: {occ.get('liveRows', 0)} rows "
                         f"{occ.get('fillFraction', 0):.1%} full, "
                         f"{k.get('overflowReplays', 0)} replays, "
                         f"{k.get('reshardsDevice', 0)}+"
                         f"{k.get('reshardsHost', 0)} reshards"
-                        if k
+                        if occ
                         else ""
                     )
+                    if h:
+                        extra += (
+                            f"  health: {h.get('state', '?')} on "
+                            f"{h.get('backend', '?')}, "
+                            f"{h.get('failovers', 0)} failovers, "
+                            f"journal {h.get('journalDepth', 0)}"
+                            f"@{h.get('journalFloor', 0)}"
+                        )
                     lines.append(
                         f"  {uid} @ {snap.get('address', '?')}: "
                         f"{snap.get('transactions', 0)} txns, "

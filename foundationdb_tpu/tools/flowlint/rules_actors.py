@@ -218,8 +218,83 @@ class CancelledSwallowRule(Rule):
                 )
 
 
+class UnboundedRetryRule(Rule):
+    """A `while True:` retry loop in an actor that swallows errors around
+    an await with no deadline, attempt bound, or backoff spins hot against
+    a dead dependency — and in simulation it can spin in zero virtual
+    time, starving every other actor on the loop. The reference's retry
+    idiom always carries delay()/timeout() (genericactors' retry shapes);
+    the resolver's kernel dispatch retry (server/resolver.py) is the
+    bounded+backoff template."""
+
+    id = "actor-unbounded-retry"
+    title = "unbounded retry loop around an await (no deadline/bound/backoff)"
+    scope = "all"
+
+    # call names (resolved through import aliases) that bound a retry loop:
+    # a sleep between attempts, an overall deadline, or the client's
+    # on_error (bounded exponential backoff + re-raise of non-retryables)
+    BOUNDING = {"delay", "timeout", "yield_now", "on_error"}
+
+    def check_module(self, mod: Module, config: dict) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for inner in _walk_in_scope(node):
+                if isinstance(inner, ast.While) and self._const_true(inner.test):
+                    yield from self._check_loop(mod, node, inner)
+
+    @staticmethod
+    def _const_true(test: ast.AST) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value)
+
+    def _check_loop(
+        self, mod: Module, fn: ast.AsyncFunctionDef, loop: ast.While
+    ) -> Iterator[Finding]:
+        # a RETRY loop: a try whose body awaits, with a non-Cancelled
+        # handler that neither re-raises nor exits the loop — control
+        # falls back to the loop top on every failure
+        retry = False
+        for t in _walk_in_scope(loop):
+            if not isinstance(t, ast.Try):
+                continue
+            if not any(
+                _contains(s, (ast.Await,)) or isinstance(s, ast.Await)
+                for s in t.body
+            ):
+                continue
+            for h in t.handlers:
+                if _handler_names(h) & CANCELLED_NAMES:
+                    continue
+                exits = any(
+                    isinstance(n, (ast.Raise, ast.Break, ast.Return))
+                    for n in _walk_in_scope(h)
+                )
+                if not exits:
+                    retry = True
+        if not retry:
+            return
+        # bounded if the loop body contains ANY backoff/deadline call —
+        # delay() between attempts, timeout() around the await
+        for n in _walk_in_scope(loop):
+            if isinstance(n, ast.Call):
+                dotted = mod.dotted(n.func) or ""
+                if dotted.rsplit(".", 1)[-1] in self.BOUNDING:
+                    return
+        yield mod.finding(
+            self.id,
+            loop,
+            fn.name,
+            f"`while True` retry loop in actor `{fn.name}` swallows errors "
+            f"with no deadline, attempt bound, or backoff — a dead "
+            f"dependency spins it hot forever; add delay()/timeout() or a "
+            f"bounded for-loop",
+        )
+
+
 RULES: list[Rule] = [
     DroppedFutureRule(),
     BlockingCallRule(),
     CancelledSwallowRule(),
+    UnboundedRetryRule(),
 ]

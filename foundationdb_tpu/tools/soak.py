@@ -48,6 +48,7 @@ from ..workloads import (
     ConsistencyCheckWorkload,
     CycleWorkload,
     DiskFailureWorkload,
+    KernelChaosWorkload,
     RandomCloggingWorkload,
     RandomMoveKeysWorkload,
     RollbackWorkload,
@@ -80,7 +81,9 @@ def random_config(rng) -> tuple[ClusterConfig, int, int]:
     return cfg, n_coordinators, n_zones
 
 
-def run_one(seed: int, verbose: bool = False) -> dict:
+def run_one(
+    seed: int, verbose: bool = False, force_kernel_faults: bool = False
+) -> dict:
     """One randomized chaos run; raises on any check failure."""
     knobs = Knobs()
     sim = Sim(seed=seed, knobs=knobs, chaos=True)
@@ -88,6 +91,18 @@ def run_one(seed: int, verbose: bool = False) -> dict:
     shape_rng = sim.loop.random.fork()
     knobs.randomize(shape_rng)
     cfg, n_coordinators, n_zones = random_config(shape_rng)
+    # device-fault injection at the conflict seam (conflict/faults.py):
+    # tpu-backed shapes arm it half the time — the kernel-fault buggify
+    # sites then fire through the run's seeded chaos machinery.
+    # force_kernel_faults pins the single-device twin ("tpu1"): the pinned
+    # coverage seed must dispatch on a device backend regardless of how
+    # many virtual devices the host process initialized jax with
+    if force_kernel_faults:
+        cfg.conflict_backend = "tpu1"
+    if cfg.conflict_backend in ("tpu", "tpu1") and (
+        force_kernel_faults or shape_rng.coinflip(0.5)
+    ):
+        knobs.CONFLICT_FAULT_INJECTION = True
     cluster = DynamicCluster(
         sim, cfg, n_coordinators=n_coordinators, n_zones=n_zones
     )
@@ -151,6 +166,12 @@ def run_one(seed: int, verbose: bool = False) -> dict:
                 db, rng.fork(), coordinators=cluster.coordinators, changes=1
             )
         )
+    if knobs.CONFLICT_FAULT_INJECTION:
+        # oracle-parity ledger under kernel faults: exact-tally increments
+        # must survive failover/journal-replay cycles (zero false commits)
+        workloads.append(
+            KernelChaosWorkload(db, rng.fork(), actors=2, increments=5)
+        )
     if shape_rng.coinflip(0.3) and cfg.replication > 1:
         workloads.append(
             DiskFailureWorkload(
@@ -169,14 +190,40 @@ def run_one(seed: int, verbose: bool = False) -> dict:
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)
+    sites = buggify_site_names(sim.buggify.fired)
     if verbose:
         print(
             f"seed {seed}: shape p{cfg.n_proxies} r{cfg.n_resolvers} "
             f"t{cfg.n_tlogs} s{cfg.n_storage}x{cfg.replication} "
             f"zones={n_zones} coords={n_coordinators} kills={kills} "
-            f"backend={cfg.conflict_backend} buggify_fired={fired}"
+            f"backend={cfg.conflict_backend}"
+            f"{' faults=on' if knobs.CONFLICT_FAULT_INJECTION else ''} "
+            f"buggify_fired={fired}"
         )
-    return {"seed": seed, "buggify_fired": fired, "config": cfg.as_dict()}
+        kernel = [s for s in sites if s.startswith("kernel-")]
+        if kernel:
+            print(f"  kernel-fault sites fired: {', '.join(kernel)}")
+    return {
+        "seed": seed,
+        "buggify_fired": fired,
+        "buggify_sites": sites,
+        "kernel_faults_armed": bool(knobs.CONFLICT_FAULT_INJECTION),
+        "config": cfg.as_dict(),
+    }
+
+
+def buggify_site_names(fired) -> list:
+    """Human-readable fired-site names for the coverage report: code sites
+    render as `file.py:line`, named sites (the kernel-fault injector's)
+    keep their tag."""
+    names = []
+    for site in fired:
+        f, tag = site
+        if isinstance(tag, int):
+            names.append(f"{os.path.basename(str(f))}:{tag}")
+        else:
+            names.append(str(tag))
+    return sorted(names)
 
 
 def main(argv=None) -> int:
@@ -184,13 +231,21 @@ def main(argv=None) -> int:
     n = int(argv[0]) if argv else 20
     first = int(argv[1]) if len(argv) > 1 else 0
     failures = []
+    coverage: dict[str, int] = {}  # fired site → runs that hit it
     for seed in range(first, first + n):
         try:
-            run_one(seed, verbose=True)
+            out = run_one(seed, verbose=True)
         except Exception as e:  # noqa: BLE001 — report and continue
             failures.append((seed, repr(e)))
             print(f"seed {seed}: FAILED {e!r}")
+        else:
+            for s in set(out["buggify_sites"]):
+                coverage[s] = coverage.get(s, 0) + 1
     print(f"{n - len(failures)}/{n} seeds green")
+    if coverage:
+        print(f"buggify coverage ({len(coverage)} sites fired):")
+        for s, runs in sorted(coverage.items(), key=lambda kv: (-kv[1], kv[0])):
+            print(f"  {s}: {runs}/{n} runs")
     for seed, err in failures:
         print(f"  repro: seed={seed} {err}")
     return 1 if failures else 0

@@ -61,3 +61,4 @@ from .chaos_extra import (  # noqa: E402,F401
     RandomMoveKeysWorkload,
     RollbackWorkload,
 )
+from .kernel_chaos import KernelChaosWorkload  # noqa: E402,F401

@@ -106,6 +106,27 @@ FIXTURES = {
         "    except Exception:\n"
         "        pass\n",
     ),
+    "actor-unbounded-retry": (
+        # error-swallowing while-True retry with no pacing: spins hot
+        "async def f(ep):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return await ep()\n"
+        "        except Cancelled:\n"
+        "            raise\n"
+        "        except Exception:\n"
+        "            pass\n",
+        # same loop with backoff between attempts: the approved shape
+        "from ..runtime.futures import delay\n"
+        "async def f(ep):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return await ep()\n"
+        "        except Cancelled:\n"
+        "            raise\n"
+        "        except Exception:\n"
+        "            await delay(0.5)\n",
+    ),
 }
 
 
@@ -162,6 +183,46 @@ def test_dropped_self_method_coroutine_in_init():
     hits = rule_hits(src, "actor-dropped-future")
     assert [f.detail for f in hits] == ["self.warm_up"]
     assert hits[0].scope == "C.__init__"
+
+
+def test_unbounded_retry_accepts_bounds_and_exits():
+    """The retry rule keys on error-driven repetition: bounded for-loops,
+    the client's on_error backoff idiom, and handlers that exit the loop
+    all pass; only the hot-spin shape flags."""
+    bounded_for = (
+        "async def f(ep):\n"
+        "    for _attempt in range(5):\n"
+        "        try:\n"
+        "            return await ep()\n"
+        "        except Exception:\n"
+        "            pass\n"
+    )
+    on_error_idiom = (
+        "async def f(db, body):\n"
+        "    tr = db.transaction()\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return await body(tr)\n"
+        "        except Cancelled:\n"
+        "            raise\n"
+        "        except Exception as e:\n"
+        "            await tr.on_error(e)\n"
+    )
+    handler_exits = (
+        "async def f(ep):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return await ep()\n"
+        "        except Exception:\n"
+        "            break\n"
+    )
+    server_loop = (  # not a retry loop: no error swallowed around the await
+        "async def f(var):\n"
+        "    while True:\n"
+        "        await var.on_change()\n"
+    )
+    for src in (bounded_for, on_error_idiom, handler_exits, server_loop):
+        assert not rule_hits(src, "actor-unbounded-retry"), src
 
 
 def test_cancelled_swallow_requires_an_await_in_try():

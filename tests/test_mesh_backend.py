@@ -130,7 +130,7 @@ def test_mesh_in_cluster():
     from foundationdb_tpu.conflict.mesh_backend import MeshConflictSet as M
 
     assert any(
-        isinstance(r.cs, M) for r in cluster.resolvers
+        isinstance(r.cs.primary, M) for r in cluster.resolvers
     ), "cluster resolver did not auto-upgrade to the mesh backend"
     db = Database(sim, cluster.proxy_addrs)
 

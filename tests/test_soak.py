@@ -21,3 +21,18 @@ def test_buggify_fires_under_chaos():
     for seed in (2, 3):
         fired += run_one(seed)["buggify_fired"]
     assert fired > 0
+
+
+def test_soak_reports_fired_sites_and_kernel_faults_fire():
+    """Buggify coverage report (ISSUE 10): the soak summary names every
+    fired site, and under the pinned seed the kernel-fault-injection
+    sites (conflict/faults.py) fire at least once — so the device-fault
+    chaos surface can never silently rot out of the matrix."""
+    out = run_one(0, force_kernel_faults=True)
+    assert out["kernel_faults_armed"]
+    sites = out["buggify_sites"]
+    assert len(sites) == out["buggify_fired"]
+    # code sites render as file:line, named sites keep their tag
+    assert any(":" in s for s in sites)
+    kernel = [s for s in sites if s.startswith("kernel-")]
+    assert kernel, f"no kernel-fault site fired under the pinned seed: {sites}"

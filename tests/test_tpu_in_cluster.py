@@ -143,12 +143,14 @@ def test_tpu_backend_survives_recovery():
     assert drive(sim, go(), limit=600.0)
 
 
-def test_resolver_backend_failure_does_not_wedge():
-    """A fatal conflict-backend error mid-pipeline must not wedge the
-    resolver's reply gate: later batches fail fast (so recovery can
-    replace the role) instead of blocking forever (ADVICE r3: gate
-    advance was skipped when handle() raised)."""
-    from foundationdb_tpu.runtime.futures import settled
+def test_resolver_backend_failure_fails_over_not_wedges():
+    """A conflict-backend error mid-pipeline no longer poisons the
+    resolver (the old permanent `_broken` path): every batch keeps
+    resolving through journal-replay recovery, repeated strikes flip the
+    health machine to FAILED_OVER onto the native/oracle fallback, and
+    neither gate ever wedges (ADVICE r3: gate advance was skipped when
+    handle() raised)."""
+    from foundationdb_tpu.conflict.failover import FAILED, FAILED_OVER
     from foundationdb_tpu.server.interfaces import (
         ResolveBatchRequest,
         TransactionData,
@@ -181,27 +183,34 @@ def test_resolver_backend_failure_does_not_wedge():
         ok = await r.resolve(req(0, 10))
         assert ok.committed
 
-        # poison the backend: every later dispatch/collect raises
+        # poison the device dispatch path: every later dispatch raises
         def boom(*a, **kw):
             raise RuntimeError("device gone")
 
         r.cs.detect_many_encoded_async = boom
-        err1 = None
-        try:
-            await r.resolve(req(10, 20))
-        except Exception as e:
-            err1 = e
-        assert err1 is not None
-        # subsequent batches must fail fast, not hang on either gate —
-        # including the one AFTER a fail-fast raise (the fail-fast path
-        # must advance the gates it skipped past)
-        for prev, ver in ((20, 30), (30, 40), (40, 50)):
-            err2 = None
+        # batches keep resolving — recovery re-resolves each on a
+        # journal-rebuilt backend, then strikes force a failover
+        for prev, ver in ((10, 20), (20, 30), (30, 40), (40, 50)):
+            rep = await r.resolve(req(prev, ver))
+            assert rep.committed == [1], (prev, ver)  # conflict: a-b written at v10
+        health = r.cs.health_snapshot()
+        assert health["state"] == FAILED_OVER
+        assert health["failovers"] == 1
+        assert health["faults"] > 0
+        # structured degraded state is in resolver.metrics → kernel.health
+        assert r.stats.snapshot()["kernel"]["health"]["state"] == FAILED_OVER
+
+        # terminal hard failure (kernel AND fallback gone) fails FAST and
+        # typed, advancing both gates so the version chain never wedges
+        r.cs.health = FAILED
+        r.cs.last_error = "fallback gone too"
+        for prev, ver in ((50, 60), (60, 70)):
+            err = None
             try:
                 await r.resolve(req(prev, ver))
             except Exception as e:
-                err2 = e
-            assert err2 is not None and "failed" in str(err2), (prev, ver)
+                err = e
+            assert err is not None and "kernel failed" in str(err), (prev, ver)
         return True
 
     fut = spawn(go())
