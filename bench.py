@@ -125,6 +125,7 @@ def bench_range_index():
                 "value": round(tpu_qps, 1),
                 "unit": "lookups/s",
                 "vs_baseline": round(tpu_qps / host_qps, 3),
+                "native_lookups_s": round(host_qps, 1),  # the denominator
             }
         )
     )
@@ -218,11 +219,143 @@ def bench_e2e():
                 "value": round(tps, 1),
                 "unit": "txn/s",
                 "vs_baseline": round(tps / 46000.0, 4),
+                "native_txn_s": 46000.0,  # the reference-cluster denominator
                 "p50_commit_ms_simtime": round(p50, 2),
                 "p95_commit_ms_simtime": round(p95, 2),
                 "backend": backend,
                 "net_profile": net,
             }
+        )
+    )
+
+
+def bench_resolver_pipeline():
+    """BENCH_COMPONENT=resolver_pipeline: before/after evidence for the
+    double-buffered conflict pipeline (ISSUE 11). Runs a Resolver on the
+    REAL loop personality with the run-loop profiler installed, resolving
+    the same chained commit batches through the device backend twice:
+
+      before — CONFLICT_ENCODE_THREADS=0: host encode serialized inside
+               the dispatch job on the device thread (the pre-PR shape);
+      after  — the default dedicated encode executor: batch N encodes
+               while batch N-1's device scan is in flight.
+
+    Prints ONE JSON line embedding both run_loop snapshots (busy
+    fraction, per-priority starvation, slow tasks) and kernel snapshots
+    (encodeOverlapSeconds = encode time hidden off the critical path)
+    next to txn/s. NOTE on a 1-core host the overlap is bounded by the
+    core count (degraded-evidence capture, BENCH_NOTES.md); on-chip the
+    scan occupies the device while the host encodes, so the hidden
+    fraction is the real win."""
+    import jax
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from foundationdb_tpu.runtime import profiler as profiler_mod
+    from foundationdb_tpu.runtime.futures import spawn
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.runtime.loop import RealLoop, set_loop
+    from foundationdb_tpu.server.interfaces import (
+        ResolveBatchRequest,
+        TransactionData,
+    )
+    from foundationdb_tpu.server.resolver import Resolver
+
+    batches_n = int(os.environ.get("BENCH_PIPE_BATCHES", "30"))
+    txns_n = int(os.environ.get("BENCH_PIPE_TXNS", "256"))
+    cap = 1 << 14
+    batches = make_batches(batches_n, txns_n, seed=3)
+    reqs = []
+    prev = 0
+    for i, txs in enumerate(batches):
+        ver = prev + 10
+        reqs.append(
+            ResolveBatchRequest(
+                version=ver,
+                prev_version=prev,
+                transactions=[
+                    TransactionData(
+                        read_snapshot=max(0, ver - 500),
+                        read_conflict_ranges=list(t.read_conflict_ranges),
+                        write_conflict_ranges=list(t.write_conflict_ranges),
+                        mutations=[],
+                    )
+                    for t in txs
+                ],
+                last_receive_version=0,
+                requesting_proxy="px",
+            )
+        )
+        prev = ver
+
+    def run_mode(encode_threads):
+        loop = RealLoop(seed=11)
+        set_loop(loop)
+        knobs = Knobs(
+            CONFLICT_ENCODE_THREADS=encode_threads,
+            CONFLICT_DISPATCH_DEADLINE=300.0,  # CPU compiles ride under it
+        )
+        prof = profiler_mod.install(
+            loop, knobs=knobs, wall=True, ident="bench"
+        )
+        r = Resolver(
+            knobs=knobs, backend="tpu1", first_version=0, uid="r0",
+            capacity=cap, key_width=12,
+        )
+        try:
+
+            async def go():
+                futs = [spawn(r.resolve(rq)) for rq in reqs]
+                for f in futs:
+                    await f
+                return True
+
+            t0 = time.time()
+            fut = spawn(go())
+            loop.run(stop_when=fut.is_ready)
+            assert fut.get() is True
+            wall = time.time() - t0
+            kernel = r.stats.snapshot()["kernel"]
+            run_loop = prof.snapshot()
+            tps = batches_n * txns_n / wall
+            log(
+                f"encode_threads={encode_threads}: {wall:.2f}s "
+                f"= {tps/1e3:.1f} Ktxn/s, overlap "
+                f"{kernel['encodeOverlapSeconds']}"
+            )
+            return {
+                "encode_threads": encode_threads,
+                "txn_s": round(tps, 1),
+                "wall_s": round(wall, 3),
+                "run_loop": run_loop,
+                "kernel": kernel,
+            }
+        finally:
+            r.close()
+            set_loop(None)
+            loop.close()
+
+    log("warmup pass (pays the in-process XLA compiles for both modes)")
+    run_mode(0)  # discarded: both timed runs ride the warm compile cache
+    before = run_mode(0)
+    after = run_mode(int(os.environ.get("CONFLICT_ENCODE_THREADS", "1")))
+    print(
+        json.dumps(
+            {
+                "metric": "resolver_pipeline_ab",
+                "unit": "txn/s",
+                "value": after["txn_s"],
+                "vs_before": round(
+                    after["txn_s"] / max(before["txn_s"], 1e-9), 3
+                ),
+                "shape": f"{batches_n}x{txns_n}",
+                "before": before,
+                "after": after,
+            },
+            default=str,
         )
     )
 
@@ -428,6 +561,9 @@ def main():
     if os.environ.get("BENCH_COMPONENT") == "degraded_evidence":
         bench_degraded_evidence()
         return
+    if os.environ.get("BENCH_COMPONENT") == "resolver_pipeline":
+        bench_resolver_pipeline()
+        return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 
     # the device phase is gated on a probe; size the workload to what we
@@ -482,6 +618,7 @@ def main():
                 "vs_baseline": 0.0,
                 "stage": "native_baseline_only",
                 "native_txn_s": round(nat_tps, 1),
+                "shape": f"{BATCHES}x{TXNS}",
                 "device": platform,
             }
         ),
@@ -581,6 +718,13 @@ def _device_phase(batches, nat_tps, nat_verdicts):
                 "value": round(tpu_tps, 1),
                 "unit": "txn/s",
                 "vs_baseline": round(tpu_tps / nat_tps, 3),
+                # the ratio's denominator on its face (ROADMAP standing
+                # guidance: the native smoke-shape baseline swings ±18%,
+                # so a vs_baseline without its native_txn_s is ambiguous)
+                # and the workload shape, pinned to 200x2500 on-chip for
+                # cross-round comparisons
+                "native_txn_s": round(nat_tps, 1),
+                "shape": f"{BATCHES}x{TXNS}",
                 # kernel counter snapshot: occupancy / overflow replays /
                 # transfer bytes ride every capture, so a number whose run
                 # hit reshard churn carries that provenance on its face

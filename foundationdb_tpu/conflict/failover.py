@@ -28,13 +28,14 @@ awaits; this module owns what happens when those fail.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 from ..runtime.knobs import Knobs
 from ..runtime.loop import Cancelled, now as loop_now
 from ..runtime.trace import SevError, SevInfo, SevWarn, trace
 from .api import CommitTransaction, new_conflict_set
-from .faults import KernelTimeoutError
+from .faults import KernelTimeoutError, StaleEncodingError
 
 HEALTHY = "HEALTHY"
 DEGRADED = "DEGRADED"
@@ -126,6 +127,9 @@ class _GuardMetrics:
         inner = getattr(self._guard.primary, "metrics", None)
         out = inner.snapshot() if inner is not None else {}
         out["health"] = self._guard.health_snapshot()
+        # encode-executor queue depth (the resolver owns the executor and
+        # wires the callable; guard-level so it survives backend swaps)
+        out["encodeQueueDepth"] = int(self._guard.encode_queue_fn())
         return out
 
 
@@ -165,6 +169,8 @@ class GuardedConflictSet:
         self.c_probe_failures = 0
         self.c_promotions = 0
         self.c_journal_replays = 0
+        # wired by the resolver to its encode executor's queue depth
+        self.encode_queue_fn = lambda: 0
         self.metrics = _GuardMetrics(self)
         self._cs = None  # set below; _note_fault may run before it exists
         try:
@@ -436,20 +442,32 @@ class GuardedConflictSet:
             fn(now_version)
 
     def encode(self, transactions):
-        """Generation-stamped encoding: a backend swap between encode and
-        dispatch surfaces as a transient fault (the resolver re-encodes)."""
+        """Generation-stamped, TIMED encoding: returns ((gen, payload),
+        encode_seconds). The resolver runs this on its encode executor —
+        the double-buffered pipeline's off-loop thread — and uses the
+        duration to compute how much encode time was hidden behind the
+        previous batch's device scan (encodeOverlapSeconds). A backend
+        swap between encode and dispatch surfaces as a transient
+        StaleEncodingError (the resolver re-encodes)."""
+        t0 = time.perf_counter()  # flowlint: disable=det-wall-clock — phase evidence
         fn = getattr(self._cs, "encode", None)
         payload = fn(transactions) if fn is not None else list(transactions)
-        return (self._gen, payload)
+        return (self._gen, payload), time.perf_counter() - t0  # flowlint: disable=det-wall-clock — phase evidence
+
+    def note_encode_overlap(self, encode_s: float, stalled_s: float) -> None:
+        """Per-batch encode-overlap evidence: of ``encode_s`` seconds of
+        host encode, ``stalled_s`` actually delayed the dispatch — the
+        rest was hidden behind the in-flight device scan."""
+        m = getattr(self.primary, "metrics", None)
+        if m is not None and hasattr(m, "encode_overlap_s"):
+            m.encode_overlap_s.add(max(0.0, encode_s - stalled_s))
 
     def detect_many_encoded_async(self, work):
-        from .faults import KernelTransientError
-
         self._maybe_promote()
         cs = self._cs
         for (gen, _payload), _v, _old in work:
             if gen != self._gen:
-                raise KernelTransientError(
+                raise StaleEncodingError(
                     "stale encoding: backend swapped after encode()"
                 )
         if hasattr(cs, "detect_many_encoded_async"):

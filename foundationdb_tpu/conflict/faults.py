@@ -49,6 +49,14 @@ class KernelTransientError(KernelFaultError):
     transient = True
 
 
+class StaleEncodingError(KernelTransientError):
+    """The encoded payload no longer matches the backend — the version
+    base was rebased or the guard swapped backends between ``encode()``
+    and dispatch (the double-buffered pipeline encodes batch N while
+    batch N-1 is still on device, so this window is real). Transient by
+    construction: the resolver re-encodes and retries in place."""
+
+
 class KernelDeviceLostError(KernelFaultError):
     """The device is gone; rebuild or failover — in-place retry is futile."""
 
@@ -63,12 +71,16 @@ SITE_DISPATCH_ERROR = ("conflict/faults.py", "kernel-dispatch-error")
 SITE_DEVICE_LOSS = ("conflict/faults.py", "kernel-device-loss")
 SITE_HANG = ("conflict/faults.py", "kernel-dispatch-hang")
 SITE_COMPILE_STALL = ("conflict/faults.py", "kernel-compile-stall")
+SITE_ENCODE_ERROR = ("conflict/faults.py", "kernel-encode-error")
+SITE_ENCODE_HANG = ("conflict/faults.py", "kernel-encode-hang")
 
 KERNEL_FAULT_SITES = (
     SITE_DISPATCH_ERROR,
     SITE_DEVICE_LOSS,
     SITE_HANG,
     SITE_COMPILE_STALL,
+    SITE_ENCODE_ERROR,
+    SITE_ENCODE_HANG,
 )
 
 
@@ -85,6 +97,8 @@ class KernelFaultInjector:
         p_device_loss: float = 0.02,
         p_hang: float = 0.02,
         p_compile_stall: float = 0.05,
+        p_encode_error: float = 0.03,
+        p_encode_hang: float = 0.01,
         loss_duration: float = 1.0,
         stall_seconds: float = 0.25,
     ):
@@ -93,6 +107,8 @@ class KernelFaultInjector:
         self.p_device_loss = p_device_loss
         self.p_hang = p_hang
         self.p_compile_stall = p_compile_stall
+        self.p_encode_error = p_encode_error
+        self.p_encode_hang = p_encode_hang
         self.loss_duration = loss_duration
         self.stall_seconds = stall_seconds
         self._lost_until = 0.0
@@ -141,6 +157,17 @@ class KernelFaultInjector:
         elif self._roll(self.p_compile_stall, SITE_COMPILE_STALL):
             self._pending_stall = self.stall_seconds
 
+    def on_encode(self) -> None:
+        """Called in front of every host encode on the encode executor —
+        the double-buffered pipeline's off-loop thread. A raised error
+        fails the encode future (the resolver's bounded retry re-encodes);
+        an armed hang models an encode thread wedged on a poisoned batch,
+        which the resolver's dispatch deadline must bound."""
+        if self._roll(self.p_encode_error, SITE_ENCODE_ERROR):
+            raise KernelTransientError("injected encode-executor error")
+        if self._roll(self.p_encode_hang, SITE_ENCODE_HANG):
+            self._pending_stall = float("inf")
+
     def take_stall(self):
         """Seconds the in-flight dispatch should stall (inf = never
         completes), or None. Consumed once per armed fault."""
@@ -179,6 +206,7 @@ class FaultInjectingConflictSet:
         self.inner.prepare(now_version)
 
     def encode(self, transactions):
+        self.injector.on_encode()
         return self.inner.encode(transactions)
 
     def take_stall(self):

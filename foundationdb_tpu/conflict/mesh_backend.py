@@ -12,13 +12,24 @@ intra-batch overlap matrix global before the commit fixpoint, and verdicts
 are bit-identical to a single-device resolver (tests/test_mesh_backend.py
 asserts this differentially).
 
-Overflow/rebalance discipline mirrors TpuConflictSet: every dispatch
-snapshots the stacked states; per-partition pressure from the kernel
-drives `sharded.reshard_partition` (the in-cluster analog of the
-reference's ResolutionSplitRequest, fdbserver/Resolver.actor.cpp:279),
-with replay from the snapshot on overflow — callers never observe it.
-When a balanced rebalance cannot fit, every partition's grid grows
-(vmapped reshard_device) and the group replays.
+Dispatch is ONE compiled ``pjit``/shard_map program per group
+(sharded.build_sharded_resolver_many): the group's batches stack on the
+host, upload once, and an on-device lax.scan threads the DONATED stacked
+grid states through every batch — no host round-trip between batches.
+Donation discipline follows PR 2's donated-buffer race: the pre-group
+snapshot keeps the ORIGINAL (never-donated) arrays for overflow replay;
+the kernel consumes a fresh ``+ 0`` copy.
+
+Reshard/grow decisions are occupancy-driven and run BETWEEN groups:
+collected per-partition pressure against the CONFLICT_RESHARD_PRESSURE
+threshold flags partitions for a proactive rebalance (the in-cluster
+analog of the reference's ResolutionSplitRequest,
+fdbserver/Resolver.actor.cpp:279), and the stacked fill fraction against
+CONFLICT_GROW_FILL grows every partition's grid — so maintenance costs a
+deliberate pipeline bubble, never an overflow replay of live dispatches.
+Overflow replay from the snapshot remains the backstop; callers never
+observe it. A grid-shape change re-warms recently dispatched stacked
+shapes so post-reshard/post-grow dispatches stay jit-cache hits.
 
 `new_conflict_set("tpu")` auto-upgrades to this backend when more than
 one JAX device is visible; `__graft_entry__.dryrun_multichip` drives the
@@ -42,14 +53,20 @@ from . import grid as G
 from . import keys as K
 from . import sharded
 from .api import CommitTransaction, ConflictSet, Verdict
+from .faults import StaleEncodingError
 from .tpu_backend import (
     _INT32_REBASE_THRESHOLD,
+    _RECENT_SHAPES,
     _VERDICT_TABLE,
+    DEFAULT_GROW_FILL,
+    DEFAULT_RESHARD_PRESSURE,
     KernelMetrics,
     KeyReservoir,
     _bucket,
     _pick_pivots,
     encode_transactions,
+    sentinel_batch,
+    stack_batches,
     tree_nbytes,
 )
 
@@ -75,6 +92,8 @@ class MeshConflictSet(ConflictSet):
         capacity: int = 1 << 14,
         mesh=None,
         n_parts: int = None,
+        reshard_pressure: float = DEFAULT_RESHARD_PRESSURE,
+        grow_fill: float = DEFAULT_GROW_FILL,
     ):
         super().__init__()
         import jax
@@ -83,6 +102,8 @@ class MeshConflictSet(ConflictSet):
         self._jax = jax
         self._width = key_width
         self._lanes = K.lanes_for_width(key_width)
+        self._reshard_pressure = reshard_pressure
+        self._grow_fill = grow_fill
         if mesh is None:
             devs = jax.devices()
             if n_parts is None:
@@ -100,11 +121,28 @@ class MeshConflictSet(ConflictSet):
             lambda _: NamedSharding(mesh, P("part")),
             G.GridState(0, 0, 0, 0, 0),
         )
+        # stacked-batch sharding: leading group axis replicated, read
+        # slots data-parallel (matches build_sharded_resolver_many specs)
+        self._batch_sharding = G.Batch(
+            rb=NamedSharding(mesh, P(None, None, "data")),
+            re=NamedSharding(mesh, P(None, None, "data")),
+            wb=NamedSharding(mesh, P()),
+            we=NamedSharding(mesh, P()),
+            t_snap=NamedSharding(mesh, P()),
+            t_has_reads=NamedSharding(mesh, P()),
+        )
         self._states = self._fresh_states()
-        self._step = sharded.build_sharded_resolver(mesh, lanes=self._lanes)
+        self._step_many = sharded.build_sharded_resolver_many(
+            mesh, lanes=self._lanes
+        )
         self._base = -1
         self._base_epoch = 0
         self._inflight: list[dict] = []
+        # occupancy-driven maintenance flags, set at collect from the
+        # per-partition pressure, executed between groups
+        self._rebalance_parts: set[int] = set()
+        # stacked shapes re-warmed whenever the grid shape (B) changes
+        self._recent_shapes: list[tuple] = []
         # reservoir of raw endpoint keys for sample-seeded pivot selection
         # (a device rebalance can only split between LIVE boundaries; a
         # batch flooding one gap with brand-new keys needs pivots from
@@ -130,19 +168,39 @@ class MeshConflictSet(ConflictSet):
     # -- ConflictSet interface ------------------------------------------------
 
     def warm_compile(self) -> None:
-        """Pre-compile the sharded resolver step for the smoke shape
-        (T=8, KR=KW=1) on scratch states — same first-commit-batch
-        de-stall as TpuConflictSet.warm_compile, against the mesh's
-        pjit'd step function."""
-        t0 = time.perf_counter()
-        scratch = self._fresh_states()
+        """Pre-compile the group-stacked resolver step for the smoke shape
+        (G=1, T=8, KR=KW=1) on scratch states — same first-commit-batch
+        de-stall as TpuConflictSet.warm_compile, against the mesh's pjit'd
+        scan program. Re-invoked internally (_warm_recent) after any
+        grid-shape change so post-reshard/post-grow stacked shapes are
+        pre-compiled too."""
         b = encode_transactions([], self._width, 0)
-        z = np.int32(0)
-        out = self._step(scratch, b, np.int32(1), z, z)
+        self._warm_shape((1, b.rb.shape[0], b.rb.shape[1], b.wb.shape[1]))
+
+    def _warm_shape(self, shape: tuple) -> None:
+        t0 = time.perf_counter()
+        Gn, T, KR, KW = shape
+        scratch = self._fresh_states()
+        b = sentinel_batch(T, KR, KW, self._lanes)
+        stacked = self._put_batches(
+            G.Batch(*(np.broadcast_to(a[None], (Gn,) + a.shape) for a in b))
+        )
+        zeros = np.zeros(Gn, np.int32)
+        out = self._step_many(scratch, stacked, zeros, zeros, zeros)
         self._jax.block_until_ready(out)
-        self.metrics.note_shape((b.rb.shape[0], b.rb.shape[1], b.wb.shape[1]))
+        self.metrics.note_shape((Gn, T, KR, KW, self._B), warm=True)
         self.metrics.warm_compiles.add()
         self.metrics.warm_s.add(time.perf_counter() - t0)
+
+    def _note_recent_shape(self, shape: tuple) -> None:
+        if shape in self._recent_shapes:
+            return
+        self._recent_shapes.append(shape)
+        del self._recent_shapes[:-_RECENT_SHAPES]
+
+    def _warm_recent(self) -> None:
+        for shape in self._recent_shapes:
+            self._warm_shape(shape)
 
     def clear(self, version: int) -> None:
         self._flush()
@@ -166,42 +224,73 @@ class MeshConflictSet(ConflictSet):
         self._maybe_rebase(now)
 
     def encode(self, transactions):
+        """Host encode — safe off-thread (see TpuConflictSet.encode: epoch
+        and base read first, so a concurrent rebase surfaces as a
+        StaleEncodingError at dispatch, never a mis-based encoding)."""
         t0 = time.perf_counter()
+        epoch, base = self._base_epoch, self._base
         b = encode_transactions(
-            transactions, self._width, self._base, sample_cb=self._sample.add
+            transactions, self._width, base, sample_cb=self._sample.add
         )
         self.metrics.encode_s.add(time.perf_counter() - t0)
-        return b, len(transactions), self._base_epoch
+        return b, len(transactions), epoch
 
     def detect_many_encoded(self, work):
         return self.detect_many_encoded_async(work)()
 
     def detect_many_encoded_async(self, work):
         """Same pipelining contract as TpuConflictSet: dispatch without
-        waiting, collect later; inter-batch state dependency lives on the
-        mesh."""
+        waiting, collect later; the inter-batch state dependency lives on
+        the mesh (one donated scan program per group)."""
         if not work:
             return lambda: []
-        items = []
-        for (b, n_real, epoch), now, new_oldest in work:
+        for (_b, _n, epoch), _now, _old in work:
             if epoch != self._base_epoch:
-                raise RuntimeError(
+                raise StaleEncodingError(
                     "stale encoding: version base was rebased after encode()"
                 )
+        counts = []
+        metas = []  # (now, oldest_pre, oldest_post) absolute versions
+        batches = []
+        for (b, n_real, _epoch), now, new_oldest in work:
             horizon = max(self.oldest_version, new_oldest)
-            item = (
-                b,
-                n_real,
-                np.int32(now - self._base),
-                np.int32(max(self.oldest_version - self._base, 0)),
-                np.int32(max(horizon - self._base, 0)),
-            )
+            metas.append((now, self.oldest_version, horizon))
             self.oldest_version = horizon
-            items.append(item)
+            counts.append(n_real)
+            batches.append(b)
         self.metrics.groups.add()
-        self.metrics.batches.add(len(items))
-        self.metrics.txns.add(sum(n for _b, n, _now, _op, _opost in items))
-        group = {"items": items, "done": None}
+        self.metrics.batches.add(len(batches))
+        self.metrics.txns.add(sum(counts))
+
+        if self._rebalance_parts:
+            # occupancy-driven proactive maintenance between groups: drain
+            # the pipeline, then grow (stacked fill fraction over the
+            # CONFLICT_GROW_FILL threshold) or rebalance the flagged
+            # partitions — a deliberate bubble, never a live-dispatch stall
+            self._flush()
+            self.metrics.reshards_proactive.add()
+            occ = sharded.stacked_occupancy_stats(self._states)
+            if occ["fillFraction"] >= self._grow_fill:
+                self._grow()
+            else:
+                for p in sorted(self._rebalance_parts):
+                    self._states, pr = sharded.reshard_partition(
+                        self._states, p, self._B, self._S
+                    )
+                    self.metrics.reshards_device.add()
+                    if pr > self._S:
+                        self._host_reshard_partition(p)
+                self._states = self._jax.device_put(
+                    self._states, self._sharding
+                )
+            self._rebalance_parts.clear()
+
+        group = {
+            "batches": batches,
+            "metas": metas,
+            "counts": counts,
+            "done": None,
+        }
         self._dispatch(group)
         self._inflight.append(group)
 
@@ -212,30 +301,54 @@ class MeshConflictSet(ConflictSet):
 
     # -- internals ------------------------------------------------------------
 
+    def _put_batches(self, stacked: G.Batch):
+        return self._jax.tree_util.tree_map(
+            self._jax.device_put, stacked, self._batch_sharding
+        )
+
     def _dispatch(self, group) -> None:
         t0 = time.perf_counter()
         self.metrics.dispatches.add()
-        group["snapshot"] = self._jax.tree_util.tree_map(
-            lambda x: x + 0, self._states
+        metas = group["metas"]
+        stacked = stack_batches(group["batches"], self._lanes)
+        shape = (
+            len(metas),
+            stacked.rb.shape[1],
+            stacked.rb.shape[2],
+            stacked.wb.shape[2],
         )
-        outs = []
-        st = self._states
-        for batch, _n, now, old_pre, old_post in group["items"]:
-            self.metrics.note_shape(
-                (batch.rb.shape[0], batch.rb.shape[1], batch.wb.shape[1])
-            )
-            self.metrics.h2d_bytes.add(tree_nbytes(batch))
-            st, verdicts, pressure = self._step(st, batch, now, old_pre, old_post)
-            outs.append((verdicts, pressure))
-            # start device→host copies now — _collect's device_get then
-            # pays no extra tunnel round trip (same prefetch discipline
-            # as the single-device backend)
-            for a in (verdicts, pressure):
-                copy_async = getattr(a, "copy_to_host_async", None)
-                if copy_async is not None:
-                    copy_async()
-        self._states = st
-        group["outs"] = outs
+        self._note_recent_shape(shape)
+        self.metrics.note_shape(shape + (self._B,))
+        self.metrics.h2d_bytes.add(tree_nbytes(stacked))
+        stacked = self._put_batches(stacked)
+        nows = np.asarray([m[0] - self._base for m in metas], np.int32)
+        olds_pre = np.asarray(
+            [max(m[1] - self._base, 0) for m in metas], np.int32
+        )
+        olds_post = np.asarray(
+            [max(m[2] - self._base, 0) for m in metas], np.int32
+        )
+        # the step DONATES its states argument: the pre-group snapshot
+        # keeps the ORIGINAL arrays (never donated → always intact for a
+        # replay) and the kernel consumes a fresh `+ 0` copy whose only
+        # reference is this dispatch — the exact discipline of PR 2's
+        # donated-buffer race fix in the single-device backend (the
+        # previous mesh code had it backwards: it donated the original and
+        # kept the copy, racing the async snapshot read)
+        group["snapshot"] = self._states
+        work = self._jax.tree_util.tree_map(lambda x: x + 0, self._states)
+        states, verdicts, pressures = self._step_many(
+            work, stacked, nows, olds_pre, olds_post
+        )
+        self._states = states
+        group["verdicts"] = verdicts  # int8[G, T]
+        group["pressures"] = pressures  # int32[G, n_parts, 2]
+        # start device→host copies now — _collect's device_get then pays
+        # no extra tunnel round trip
+        for a in (verdicts, pressures):
+            copy_async = getattr(a, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
         self.metrics.dispatch_s.add(time.perf_counter() - t0)
 
     def _collect(self, group):
@@ -247,9 +360,12 @@ class MeshConflictSet(ConflictSet):
         t0 = time.perf_counter()
         S2 = G.staging_slots(self._S)
         for attempt in range(6):
-            pressures = self._jax.device_get([p for _v, p in group["outs"]])
-            self.metrics.d2h_bytes.add(sum(int(p.nbytes) for p in pressures))
-            worst = np.max(np.stack(pressures), axis=0)  # [n_parts, 2]
+            # one host↔device round trip for both pressures and verdicts
+            prs, out = self._jax.device_get(
+                (group["pressures"], group["verdicts"])
+            )
+            self.metrics.d2h_bytes.add(int(prs.nbytes) + int(out.nbytes))
+            worst = prs.max(axis=0)  # [n_parts, 2] over the group
             over = (worst[:, 0] > S2) | (worst[:, 1] > self._S)
             if not over.any():
                 break
@@ -285,19 +401,30 @@ class MeshConflictSet(ConflictSet):
         else:
             raise RuntimeError("mesh conflict grid reshard did not converge")
 
+        # proactive-rebalance signal for the NEXT group boundary: any
+        # partition whose staged/kept maxima crossed the pressure threshold
+        self._rebalance_parts.update(
+            int(p)
+            for p in np.nonzero(
+                (worst[:, 0] > int(S2 * self._reshard_pressure))
+                | (worst[:, 1] > int(self._S * self._reshard_pressure))
+            )[0]
+        )
+
         table = _VERDICT_TABLE
-        done = []
-        for (verdicts, _p), (_b, n_real, _now, _op, _opost) in zip(
-            group["outs"], group["items"]
-        ):
-            out = np.asarray(self._jax.device_get(verdicts))
-            self.metrics.d2h_bytes.add(int(out.nbytes))
-            done.append([table[v] for v in out[:n_real].tolist()])
+        done = [
+            [table[v] for v in out[g, : group["counts"][g]].tolist()]
+            for g in range(len(group["counts"]))
+        ]
         self.metrics.collect_s.add(time.perf_counter() - t0)
         group["done"] = done
+        # collected groups can never be re-dispatched: drop everything
+        # pinning device/host memory (snapshots scale with pipeline depth)
         group.pop("snapshot", None)
-        group.pop("outs", None)
-        group.pop("items", None)
+        group.pop("verdicts", None)
+        group.pop("pressures", None)
+        group.pop("batches", None)
+        group.pop("metas", None)
         self._inflight.pop(0)
         return done
 
@@ -343,7 +470,8 @@ class MeshConflictSet(ConflictSet):
 
     def _grow(self) -> None:
         """Double every partition's bucket count (vmapped on-device
-        reshard folds floors and rebalances each shard)."""
+        reshard folds floors and rebalances each shard), then re-warm the
+        recently dispatched stacked shapes at the new grid shape."""
         self._B *= 2
         self.metrics.capacity_growths.add()
         grown, _pr = self._jax.vmap(
@@ -354,6 +482,7 @@ class MeshConflictSet(ConflictSet):
             )
         )(self._states)
         self._states = self._jax.device_put(grown, self._sharding)
+        self._warm_recent()
 
     def _flush(self) -> None:
         while self._inflight:

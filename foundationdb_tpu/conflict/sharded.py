@@ -38,6 +38,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from . import grid as G
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable shard_map: prefer the stable ``jax.shard_map``
+    (newer jax, ``check_vma`` keyword); fall back to
+    ``jax.experimental.shard_map`` (``check_rep``) on older releases — the
+    jax on the bench box predates the promotion, and an AttributeError
+    here used to kill the whole mesh backend at construction."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def make_sharded_states(
     n_parts: int, n_buckets: int, n_slots: int, lanes: int
 ) -> G.GridState:
@@ -102,65 +122,54 @@ def _clip(b, e, plo, phi):
     return b2, e2
 
 
-def build_sharded_resolver(mesh: Mesh, lanes: int):
-    """Returns a jitted fn(states, batch, now, oldest_pre, oldest_post) ->
-    (states, verdicts, pressure) resolving one commit batch across the
-    mesh. ``states`` leading axis shards over ``part``; the batch's read
-    arrays shard their KR axis over ``data``; writes are replicated.
-    ``pressure`` is int32[n_parts, 2] — per-partition staging/kept
-    maxima, the host's overflow + rebalance signal (the analog of
-    ResolutionSplitRequest, Resolver.actor.cpp:279)."""
-    n_parts = mesh.shape["part"]
+def _local_resolve(state, batch: G.Batch, now, oldest_pre, oldest_post, plo, phi):
+    """One partition's view of one batch: clip ranges to the partition,
+    resolve against the local grid shard, and make verdicts global with
+    mesh collectives. The shared body of the single-batch and
+    scan-stacked (double-buffered) step functions."""
 
-    def pmax_all(x, axes):
+    def pmax_all(x, axes=("part", "data")):
         return jax.lax.pmax(x.astype(jnp.int32), axes)
 
-    def local_step(state_stk, batch: G.Batch, now, oldest_pre, oldest_post):
-        state = jax.tree.map(lambda x: x[0], state_stk)
-        pidx = jax.lax.axis_index("part")
-        plo, phi = _partition_bounds(lanes, n_parts, pidx)
+    rb, re = _clip(batch.rb, batch.re, plo, phi)
+    wb, we = _clip(batch.wb, batch.we, plo, phi)
+    local = G.Batch(
+        rb=rb,
+        re=re,
+        wb=wb,
+        we=we,
+        t_snap=batch.t_snap,
+        t_has_reads=batch.t_has_reads,
+    )
 
-        rb, re = _clip(batch.rb, batch.re, plo, phi)
-        wb, we = _clip(batch.wb, batch.we, plo, phi)
-        local = G.Batch(
-            rb=rb,
-            re=re,
-            wb=wb,
-            we=we,
-            t_snap=batch.t_snap,
-            t_has_reads=batch.t_has_reads,
-        )
+    too_old = batch.t_has_reads & (batch.t_snap < oldest_pre)
+    # global history verdict: each partition checks its clipped reads
+    # against its shard of the MVCC history, then one pmax over the
+    # whole mesh ("conflict dominates", made global)
+    H_local = G.history_conflicts(state, local)
+    H = pmax_all(H_local).astype(bool) | too_old
 
-        too_old = batch.t_has_reads & (batch.t_snap < oldest_pre)
-        # global history verdict: each partition checks its clipped reads
-        # against its shard of the MVCC history, then one pmax over the
-        # whole mesh ("conflict dominates", made global)
-        H_local = G.history_conflicts(state, local)
-        H = pmax_all(H_local, ("part", "data")).astype(bool) | too_old
+    commit = G.intra_batch_commits(
+        local,
+        H,
+        combine_pji=lambda p: pmax_all(p).astype(bool),
+    )
 
-        commit = G.intra_batch_commits(
-            local,
-            H,
-            combine_pji=lambda p: pmax_all(p, ("part", "data")).astype(bool),
-        )
+    # merge is per-partition (writes replicated along data, clipped to
+    # the partition; every data row computes the same new state)
+    new_state, pressure = G.merge_writes(
+        state, local, commit, now, oldest_post
+    )
 
-        # merge is per-partition (writes replicated along data, clipped to
-        # the partition; every data row computes the same new state)
-        new_state, pressure = G.merge_writes(
-            state, local, commit, now, oldest_post
-        )
+    verdicts = jnp.where(
+        too_old,
+        jnp.int8(G.TOO_OLD),
+        jnp.where(commit, jnp.int8(G.COMMITTED), jnp.int8(G.CONFLICT)),
+    )
+    return new_state, verdicts, pressure
 
-        verdicts = jnp.where(
-            too_old,
-            jnp.int8(G.TOO_OLD),
-            jnp.where(commit, jnp.int8(G.COMMITTED), jnp.int8(G.CONFLICT)),
-        )
-        return (
-            jax.tree.map(lambda x: x[None], new_state),
-            verdicts,
-            pressure[None],
-        )
 
+def _mesh_specs():
     state_spec = jax.tree.map(
         lambda _: P("part"), G.GridState(0, 0, 0, 0, 0)
     )
@@ -172,12 +181,87 @@ def build_sharded_resolver(mesh: Mesh, lanes: int):
         t_snap=P(),
         t_has_reads=P(),
     )
-    shard_fn = jax.shard_map(
+    return state_spec, batch_spec
+
+
+def build_sharded_resolver(mesh: Mesh, lanes: int):
+    """Returns a jitted fn(states, batch, now, oldest_pre, oldest_post) ->
+    (states, verdicts, pressure) resolving one commit batch across the
+    mesh. ``states`` leading axis shards over ``part``; the batch's read
+    arrays shard their KR axis over ``data``; writes are replicated.
+    ``pressure`` is int32[n_parts, 2] — per-partition staging/kept
+    maxima, the host's overflow + rebalance signal (the analog of
+    ResolutionSplitRequest, Resolver.actor.cpp:279)."""
+    n_parts = mesh.shape["part"]
+
+    def local_step(state_stk, batch: G.Batch, now, oldest_pre, oldest_post):
+        state = jax.tree.map(lambda x: x[0], state_stk)
+        pidx = jax.lax.axis_index("part")
+        plo, phi = _partition_bounds(lanes, n_parts, pidx)
+        new_state, verdicts, pressure = _local_resolve(
+            state, batch, now, oldest_pre, oldest_post, plo, phi
+        )
+        return (
+            jax.tree.map(lambda x: x[None], new_state),
+            verdicts,
+            pressure[None],
+        )
+
+    state_spec, batch_spec = _mesh_specs()
+    shard_fn = _shard_map(
         local_step,
-        mesh=mesh,
+        mesh,
         in_specs=(state_spec, batch_spec, P(), P(), P()),
         out_specs=(state_spec, P(), P("part")),
-        check_vma=False,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0,))
+
+
+def build_sharded_resolver_many(mesh: Mesh, lanes: int):
+    """The group-stacked face of build_sharded_resolver: ONE compiled
+    ``pjit``/shard_map program resolving a whole stacked group of batches
+    (leading axis G on every batch leaf) via an on-device lax.scan, with
+    the stacked grid states DONATED — the inter-batch state dependency
+    never leaves HBM, and the host pays one dispatch per group instead of
+    one per batch (the SNIPPETS.md pjit train-step shape: compiled,
+    automatically partitioned, donated carry).
+
+    fn(states, batches, nows, oldests_pre, oldests_post) ->
+    (states, verdicts int8[G, T], pressures int32[G, n_parts, 2]).
+    Per-batch pressures (not a group max) so the host's occupancy-driven
+    reshard decisions see exactly which batch pushed the grid where."""
+    n_parts = mesh.shape["part"]
+
+    def local_many(state_stk, batches: G.Batch, nows, oldests_pre, oldests_post):
+        state = jax.tree.map(lambda x: x[0], state_stk)
+        pidx = jax.lax.axis_index("part")
+        plo, phi = _partition_bounds(lanes, n_parts, pidx)
+
+        def step(st, inp):
+            batch, now, old_pre, old_post = inp
+            st2, verdicts, pressure = _local_resolve(
+                st, batch, now, old_pre, old_post, plo, phi
+            )
+            return st2, (verdicts, pressure)
+
+        state, (verdicts, pressures) = jax.lax.scan(
+            step, state, (batches, nows, oldests_pre, oldests_post)
+        )
+        return (
+            jax.tree.map(lambda x: x[None], state),
+            verdicts,
+            pressures[:, None],
+        )
+
+    state_spec, batch_spec1 = _mesh_specs()
+    batch_spec = jax.tree.map(
+        lambda s: P(*((None,) + tuple(s))), batch_spec1
+    )
+    shard_fn = _shard_map(
+        local_many,
+        mesh,
+        in_specs=(state_spec, batch_spec, P(), P(), P()),
+        out_specs=(state_spec, P(), P(None, "part")),
     )
     return jax.jit(shard_fn, donate_argnums=(0,))
 

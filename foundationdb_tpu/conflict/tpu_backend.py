@@ -34,10 +34,18 @@ from . import grid as G
 from . import keys as K
 from ..runtime.stats import CounterCollection
 from .api import CommitTransaction, ConflictSet, Verdict
+from .faults import StaleEncodingError
 
 _INT32_REBASE_THRESHOLD = 1 << 30
 _SAMPLE_CAP = 131072
 _VERDICT_TABLE = [Verdict(i) for i in range(3)]
+
+# occupancy-driven reshard defaults (resolver threads the CONFLICT_RESHARD_*
+# knobs in): rebalance when collected pressure crosses this fraction of the
+# slot ceiling; grow the bucket count when the live-row fill fraction does
+DEFAULT_RESHARD_PRESSURE = 0.75
+DEFAULT_GROW_FILL = 0.5
+_RECENT_SHAPES = 4  # stacked shapes re-warmed after a grid-shape change
 
 
 class KernelMetrics:
@@ -61,6 +69,7 @@ class KernelMetrics:
         self.replayed_groups = c("replayedGroups")
         self.reshards_device = c("reshardsDevice")
         self.reshards_host = c("reshardsHost")
+        self.reshards_proactive = c("reshardsProactive")
         self.capacity_growths = c("capacityGrowths")
         self.rebases = c("rebases")
         self.h2d_bytes = c("hostToDeviceBytes")
@@ -69,20 +78,28 @@ class KernelMetrics:
         self.jit_misses = c("jitCacheMisses")
         self.warm_compiles = c("warmCompiles")
         self.encode_s = self.collection.latency("encodeSeconds")
+        self.encode_overlap_s = self.collection.latency("encodeOverlapSeconds")
         self.dispatch_s = self.collection.latency("dispatchSeconds")
         self.collect_s = self.collection.latency("collectSeconds")
         self.reshard_s = self.collection.latency("reshardSeconds")
         self.warm_s = self.collection.latency("warmCompileSeconds")
         self._shapes: set = set()
 
-    def note_shape(self, key) -> None:
-        """Host-side jit-cache model: a (G, T, KR, KW) stacked shape seen
-        before hits the compile cache; a fresh one forces a compile."""
+    def note_shape(self, key, warm: bool = False) -> None:
+        """Host-side jit-cache model: a (G, T, KR, KW, B) stacked shape
+        seen before hits the compile cache; a fresh one forces a compile.
+        ``warm=True`` (warm_compile / post-reshard re-warm) seeds the cache
+        without counting a dispatch-path hit or miss — those tallies
+        measure what the LIVE pipeline paid, which is how the steady-state
+        acceptance (`hit rate ≈ 1.0`) reads them; warm work is accounted
+        in ``warmCompiles``/``warmCompileSeconds`` instead."""
         if key in self._shapes:
-            self.jit_hits.add()
+            if not warm:
+                self.jit_hits.add()
         else:
             self._shapes.add(key)
-            self.jit_misses.add()
+            if not warm:
+                self.jit_misses.add()
 
     def gauge(self, name: str, fn) -> None:
         self.collection.gauge(name, fn)
@@ -189,6 +206,55 @@ def encode_transactions(
     )
 
 
+def stack_batches(batches: list[G.Batch], lanes: int) -> G.Batch:
+    """Stack host-encoded batches into one [G, ...] group (host numpy),
+    padding every leaf to the group's max (T, KR, KW) with sentinel rows —
+    the payload a single stacked device dispatch consumes. Shared by the
+    single-device and mesh backends."""
+    T = max(b.rb.shape[0] for b in batches)
+    KR = max(b.rb.shape[1] for b in batches)
+    KW = max(b.wb.shape[1] for b in batches)
+    sent_row = np.full(lanes, 0xFFFFFFFF, dtype=np.uint32)
+
+    def pad3(a, k):
+        t, kk, _L = a.shape
+        if t == T and kk == k:
+            return a
+        out = np.tile(sent_row, (T, k, 1))
+        out[:t, :kk] = a
+        return out
+
+    def pad1(a, dtype):
+        if a.shape[0] == T:
+            return a
+        out = np.zeros(T, dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    return G.Batch(
+        rb=np.stack([pad3(b.rb, KR) for b in batches]),
+        re=np.stack([pad3(b.re, KR) for b in batches]),
+        wb=np.stack([pad3(b.wb, KW) for b in batches]),
+        we=np.stack([pad3(b.we, KW) for b in batches]),
+        t_snap=np.stack([pad1(b.t_snap, np.int32) for b in batches]),
+        t_has_reads=np.stack([pad1(b.t_has_reads, bool) for b in batches]),
+    )
+
+
+def sentinel_batch(T: int, KR: int, KW: int, lanes: int) -> G.Batch:
+    """An all-sentinel (fully inactive) batch at an exact padded shape —
+    the zero-cost payload warm compiles and re-warms dispatch against."""
+    sent_row = np.full(lanes, 0xFFFFFFFF, dtype=np.uint32)
+    return G.Batch(
+        rb=np.tile(sent_row, (T, KR, 1)),
+        re=np.tile(sent_row, (T, KR, 1)),
+        wb=np.tile(sent_row, (T, KW, 1)),
+        we=np.tile(sent_row, (T, KW, 1)),
+        t_snap=np.zeros(T, np.int32),
+        t_has_reads=np.zeros(T, bool),
+    )
+
+
 def _pick_pivots(
     cands: np.ndarray, n_buckets: int, lanes: int, lo: np.ndarray = None
 ) -> np.ndarray:
@@ -211,10 +277,18 @@ def _pick_pivots(
 
 
 class TpuConflictSet(ConflictSet):
-    def __init__(self, key_width: int = K.DEFAULT_KEY_WIDTH, capacity: int = 1 << 14):
+    def __init__(
+        self,
+        key_width: int = K.DEFAULT_KEY_WIDTH,
+        capacity: int = 1 << 14,
+        reshard_pressure: float = DEFAULT_RESHARD_PRESSURE,
+        grow_fill: float = DEFAULT_GROW_FILL,
+    ):
         super().__init__()
         self._width = key_width
         self._lanes = K.lanes_for_width(key_width)
+        self._reshard_pressure = reshard_pressure
+        self._grow_fill = grow_fill
         # grid shape: B buckets × S slots with ~2× slack over `capacity`
         # boundaries. Shallow buckets (S=32) over twice as many pivots:
         # every per-bucket pass (merge sort window, history window
@@ -230,6 +304,10 @@ class TpuConflictSet(ConflictSet):
         self._sample = KeyReservoir()
         self._resharded_once = False
         self._rebalance_wanted = False
+        # stacked shapes the live pipeline dispatched lately — re-warmed
+        # whenever the grid shape (B) changes so post-reshard/post-grow
+        # dispatches stay jit-cache hits
+        self._recent_shapes: list[tuple] = []
         # dispatched-but-uncollected groups, in dispatch order
         self._inflight: list[dict] = []
         # kernel observability (ISSUE 5): counters/samples every perf PR
@@ -251,21 +329,45 @@ class TpuConflictSet(ConflictSet):
         step PR 9's run-loop profiler attributes to the resolver band).
         Logical state and the version base are untouched; the compiled XLA
         program signature matches the first small dispatch, so that
-        dispatch is a jit-cache hit."""
-        t0 = time.perf_counter()
-        scratch = G.make_state(self._B, self._S, self._lanes)
+        dispatch is a jit-cache hit. Re-invoked internally (_warm_recent)
+        whenever a reshard/grow changes the grid shape, so every stacked
+        shape the pipeline can dispatch post-reshard is pre-compiled too."""
         b = encode_transactions([], self._width, 0)
+        self._warm_shape((1, b.rb.shape[0], b.rb.shape[1], b.wb.shape[1]))
+
+    def _warm_shape(self, shape: tuple) -> None:
+        """Compile-and-discard one stacked (G, T, KR, KW) shape against a
+        scratch grid at the CURRENT grid shape."""
+        t0 = time.perf_counter()
+        Gn, T, KR, KW = shape
+        scratch = G.make_state(self._B, self._S, self._lanes)
+        b = sentinel_batch(T, KR, KW, self._lanes)
         stacked = jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.asarray(a)[None]), b
+            lambda a: jax.device_put(
+                np.broadcast_to(np.asarray(a)[None], (Gn,) + a.shape)
+            ),
+            b,
         )
-        zero = np.zeros(1, np.int32)
-        out = G.resolve_many(scratch, stacked, zero, zero, zero)
+        zeros = np.zeros(Gn, np.int32)
+        out = G.resolve_many(scratch, stacked, zeros, zeros, zeros)
         jax.block_until_ready(out)
-        self.metrics.note_shape(
-            (1, b.rb.shape[0], b.rb.shape[1], b.wb.shape[1])
-        )
+        self.metrics.note_shape((Gn, T, KR, KW, self._B), warm=True)
         self.metrics.warm_compiles.add()
         self.metrics.warm_s.add(time.perf_counter() - t0)
+
+    def _note_recent_shape(self, shape: tuple) -> None:
+        if shape in self._recent_shapes:
+            return
+        self._recent_shapes.append(shape)
+        del self._recent_shapes[:-_RECENT_SHAPES]
+
+    def _warm_recent(self) -> None:
+        """The grid shape just changed (grow / host reshard): every stacked
+        program the pipeline compiled is stale. Pre-compile the recently
+        dispatched shapes against the new grid so the next dispatches are
+        jit-cache hits instead of in-band first compiles."""
+        for shape in self._recent_shapes:
+            self._warm_shape(shape)
 
     def _flush(self) -> None:
         while self._inflight:
@@ -304,11 +406,19 @@ class TpuConflictSet(ConflictSet):
 
     def encode(self, transactions: list[CommitTransaction]):
         """Pre-encode a batch for detect_many_encoded. Encodings are
-        base-relative: a version rebase invalidates them (epoch stamp)."""
+        base-relative: a version rebase invalidates them (epoch stamp).
+        Safe to call from the resolver's encode executor while dispatches
+        run on the device thread: epoch and base are read FIRST, so a
+        concurrent rebase can only make this encoding visibly stale
+        (StaleEncodingError at dispatch → re-encode), never silently
+        mis-based."""
         t0 = time.perf_counter()
-        b = self._encode(transactions)
+        epoch, base = self._base_epoch, self._base
+        b = encode_transactions(
+            transactions, self._width, base, sample_cb=self._sample.add
+        )
         self.metrics.encode_s.add(time.perf_counter() - t0)
-        return b, len(transactions), self._base_epoch
+        return b, len(transactions), epoch
 
     def detect_many_encoded(self, work) -> list[list[Verdict]]:
         """work: list of ((Batch, n_real, epoch), now, new_oldest)."""
@@ -324,14 +434,18 @@ class TpuConflictSet(ConflictSet):
         tunnel)."""
         if not work:
             return lambda: []
+        for (_b, _n, epoch), _now, _old in work:
+            # validate every encoding BEFORE mutating the horizon, so a
+            # stale group raises with no partial side effects (the
+            # resolver re-encodes and calls again)
+            if epoch != self._base_epoch:
+                raise StaleEncodingError(
+                    "stale encoding: version base was rebased after encode()"
+                )
         counts = []
         metas = []  # (now, oldest_pre, oldest_post) absolute versions
         batches = []
-        for (b, n_real, epoch), now, new_oldest in work:
-            if epoch != self._base_epoch:
-                raise RuntimeError(
-                    "stale encoding: version base was rebased after encode()"
-                )
+        for (b, n_real, _epoch), now, new_oldest in work:
             horizon = max(self.oldest_version, new_oldest)
             metas.append((now, self.oldest_version, horizon))
             self.oldest_version = horizon
@@ -345,12 +459,14 @@ class TpuConflictSet(ConflictSet):
         if not self._resharded_once:
             self._reshard(self._state)
         elif self._rebalance_wanted:
-            # a prior collect saw pressure near the slot ceiling: drain
-            # the pipeline and rebalance BEFORE dispatching more work — a
-            # deliberate one-group bubble instead of an overflow replay of
-            # every in-flight group later
+            # occupancy-driven proactive maintenance (the collected
+            # pressure/headroom crossed the reshard threshold): drain the
+            # pipeline and rebalance/grow BETWEEN batches — a deliberate
+            # one-group bubble instead of an overflow replay of every
+            # in-flight group later, and never a stall of a live dispatch
             self._flush()
-            self._reshard(self._state)
+            self.metrics.reshards_proactive.add()
+            self._reshard(self._state, grow=self._wants_growth())
             self._rebalance_wanted = False
 
         stacked = self._stack(batches)
@@ -373,9 +489,11 @@ class TpuConflictSet(ConflictSet):
         metas = group["metas"]
         st = group["stacked"]
         self.metrics.dispatches.add()
-        self.metrics.note_shape(
-            (len(metas), st.rb.shape[-3], st.rb.shape[-2], st.wb.shape[-2])
-        )
+        shape = (len(metas), st.rb.shape[-3], st.rb.shape[-2], st.wb.shape[-2])
+        self._note_recent_shape(shape)
+        # the compiled program is keyed by the batch shape AND the grid
+        # shape: a grow recompiles, which is why it re-warms (_warm_recent)
+        self.metrics.note_shape(shape + (self._B,))
         nows = np.asarray([m[0] - self._base for m in metas], np.int32)
         olds_pre = np.asarray(
             [max(m[1] - self._base, 0) for m in metas], np.int32
@@ -452,16 +570,19 @@ class TpuConflictSet(ConflictSet):
             raise RuntimeError("conflict grid reshard did not converge")
         self._last_pressure = (int(pr[0]), int(pr[1]))
         self.metrics.collect_s.add(time.perf_counter() - t0)
-        if int(pr[1]) > self._S - max(4, self._S // 4) or int(pr[0]) > S2 - max(
-            2, S2 // 4
-        ):
-            # close to the slot ceiling: rebalance before more work lands.
-            # With nothing else in flight do it now; otherwise flag it for
-            # the next dispatch (which drains the pipeline first). Growth
-            # is reshard_device's own call — it grows exactly when a
-            # balanced quantile split can't fit its slot budget.
+        if int(pr[1]) > int(self._S * self._reshard_pressure) or int(
+            pr[0]
+        ) > int(S2 * self._reshard_pressure):
+            # the occupancy/headroom signal crossed the reshard threshold
+            # (CONFLICT_RESHARD_PRESSURE): rebalance before more work
+            # lands. With nothing else in flight do it now; otherwise flag
+            # it for the next dispatch (which drains the pipeline first).
+            # Growth is decided from the live-row fill fraction
+            # (CONFLICT_GROW_FILL) — and reshard_device still grows on its
+            # own exactly when a balanced quantile split can't fit.
             if len(self._inflight) == 1:
-                self._reshard(self._state)
+                self.metrics.reshards_proactive.add()
+                self._reshard(self._state, grow=self._wants_growth())
                 self._rebalance_wanted = False
             else:
                 self._rebalance_wanted = True
@@ -484,40 +605,15 @@ class TpuConflictSet(ConflictSet):
 
     # -- internals ------------------------------------------------------------
 
-    def _encode(self, transactions) -> G.Batch:
-        return encode_transactions(
-            transactions, self._width, self._base, sample_cb=self._sample.add
-        )
+    def _wants_growth(self) -> bool:
+        """Live-row fill fraction against the grow threshold — consulted
+        only on proactive reshard decisions, when the pipeline is drained
+        (reading ``count`` then costs no pipeline sync)."""
+        occ = G.occupancy_stats(self._state)
+        return occ["fillFraction"] >= self._grow_fill
 
     def _stack(self, batches: list[G.Batch]) -> G.Batch:
-        T = max(b.rb.shape[0] for b in batches)
-        KR = max(b.rb.shape[1] for b in batches)
-        KW = max(b.wb.shape[1] for b in batches)
-        sent_row = np.full(self._lanes, 0xFFFFFFFF, dtype=np.uint32)
-
-        def pad3(a, k):
-            t, kk, L = a.shape
-            if t == T and kk == k:
-                return a
-            out = np.tile(sent_row, (T, k, 1))
-            out[:t, :kk] = a
-            return out
-
-        def pad1(a, dtype):
-            if a.shape[0] == T:
-                return a
-            out = np.zeros(T, dtype)
-            out[: a.shape[0]] = a
-            return out
-
-        stacked = G.Batch(
-            rb=np.stack([pad3(b.rb, KR) for b in batches]),
-            re=np.stack([pad3(b.re, KR) for b in batches]),
-            wb=np.stack([pad3(b.wb, KW) for b in batches]),
-            we=np.stack([pad3(b.we, KW) for b in batches]),
-            t_snap=np.stack([pad1(b.t_snap, np.int32) for b in batches]),
-            t_has_reads=np.stack([pad1(b.t_has_reads, bool) for b in batches]),
-        )
+        stacked = stack_batches(batches, self._lanes)
         # upload asynchronously NOW: with pipelined dispatches the transfer
         # overlaps earlier groups' device compute instead of stalling the
         # dispatch inside the jit call (a ~46 ms/group synchronous upload
@@ -539,6 +635,7 @@ class TpuConflictSet(ConflictSet):
         overflow-replay escalation and the initial reshard use the host
         path, whose pivots also come from the recent key sample."""
         t0 = time.perf_counter()
+        B0 = self._B
         if self._resharded_once and not with_sample:
             if grow:
                 self._B *= 2
@@ -549,6 +646,8 @@ class TpuConflictSet(ConflictSet):
                     self._state = state
                     self.metrics.reshards_device.add()
                     self.metrics.reshard_s.add(time.perf_counter() - t0)
+                    if self._B != B0:
+                        self._warm_recent()
                     return
                 # quantile split can't fit: more buckets and retry
                 self._B *= 2
@@ -556,6 +655,8 @@ class TpuConflictSet(ConflictSet):
         self._reshard_host_sampled(from_state, grow=grow)
         self.metrics.reshards_host.add()
         self.metrics.reshard_s.add(time.perf_counter() - t0)
+        if self._B != B0:
+            self._warm_recent()
 
     def _reshard_host_sampled(
         self, from_state: G.GridState, grow: bool = False

@@ -44,6 +44,16 @@ class Knobs:
     CONFLICT_REBUILD_ATTEMPTS = 2  # device rebuild tries per recovery resolve
     CONFLICT_REPROBE_INTERVAL = 1.0  # probe cadence for device re-promotion (s)
     CONFLICT_JOURNAL_CAPACITY = 512  # journaled committed-write batches kept
+    # double-buffered dispatch: size of the resolver's dedicated host
+    # encode executor (batch N encodes while batch N-1 scans on device);
+    # 0 = encode synchronously inside the dispatch job (pre-overlap shape)
+    CONFLICT_ENCODE_THREADS = 1
+    # occupancy-driven proactive resharding (between batches, never
+    # stalling a live dispatch): rebalance when collected staging/kept
+    # pressure crosses this fraction of the slot ceiling…
+    CONFLICT_RESHARD_PRESSURE = 0.75
+    # …and grow the bucket count when live rows fill this fraction of grid
+    CONFLICT_GROW_FILL = 0.5
     # sim-only seeded device-fault injection at the conflict seam
     # (conflict/faults.py): dispatch errors, hangs, device loss, stalls
     CONFLICT_FAULT_INJECTION = False
@@ -210,6 +220,15 @@ class Knobs:
             self.CONFLICT_REPROBE_INTERVAL = rng.random_choice([0.3, 1.0, 3.0])
         if rng.coinflip(0.25):
             self.CONFLICT_JOURNAL_CAPACITY = rng.random_choice([64, 512, 2048])
+        if rng.coinflip(0.25):
+            # 0 exercises the legacy encode-in-dispatch shape; >0 the
+            # double-buffered path (inline in sim, but with the early
+            # pre-gate encode ordering and its stale-encoding window)
+            self.CONFLICT_ENCODE_THREADS = rng.random_choice([0, 1, 2])
+        if rng.coinflip(0.25):
+            self.CONFLICT_RESHARD_PRESSURE = rng.random_choice([0.5, 0.75, 0.9])
+        if rng.coinflip(0.25):
+            self.CONFLICT_GROW_FILL = rng.random_choice([0.25, 0.5, 0.8])
         # coupled constraint: a proxy must keep waiting for a version
         # grant at least as long as the master might legitimately park it
         # behind a gap, or slow-but-honored grants get double-assigned

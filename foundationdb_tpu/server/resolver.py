@@ -34,20 +34,26 @@ _TIMED_OUT = object()  # timeout() sentinel (None is a legal future value)
 
 
 class _SerialExecutor:
-    """One daemon thread running submitted thunks in order, resolving
-    their futures back on the event loop via ``loop.post``. The resolver's
-    device waits (TPU collects can block for a tunnel round trip or a
-    first-shape compile) run here so the worker's loop keeps servicing
+    """Daemon thread(s) running submitted thunks, resolving their futures
+    back on the event loop via ``loop.post``. The resolver's device waits
+    (TPU collects can block for a tunnel round trip or a first-shape
+    compile) run here so the worker's loop keeps servicing
     heartbeats/elections — the role-thread split of the reference's
-    onMainThread bridging (flow/ThreadHelper.actor.h)."""
+    onMainThread bridging (flow/ThreadHelper.actor.h). With
+    ``n_threads == 1`` submission order is execution order (the device
+    pipeline's requirement); the resolver's ENCODE executor may run more
+    threads (CONFLICT_ENCODE_THREADS) since encodes are independent."""
 
-    def __init__(self):
+    def __init__(self, n_threads: int = 1):
         import queue
         import threading
 
         self._q = queue.Queue()
-        t = threading.Thread(target=self._run, daemon=True)
-        t.start()
+        self._n = max(1, int(n_threads))
+        self._depth = 0  # submitted-but-unfinished jobs (observability)
+        for _ in range(self._n):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
 
     def _run(self):
         while True:
@@ -60,27 +66,40 @@ class _SerialExecutor:
                 # runs ON the loop thread: resolve + retire the external
                 # work marker in one scheduled step
                 err, result = outcome
+                self._depth -= 1
                 loop.external_end()
                 if err is not None:
                     fut._set_error(err)
                 else:
                     fut._set(result)
 
+            # the posted completion must bind THIS job's finish by value:
+            # captured by closure name, the next loop iteration rebinds
+            # `finish` before the loop thread drains the post, and job N's
+            # outcome resolves job N+1's future (observed as warm_compile's
+            # None delivered to a dispatch await once the double-buffered
+            # pipeline kept more than one job in flight)
             try:
-                result = fn()
+                outcome = (None, fn())
             except BaseException as e:
-                loop.post(lambda e=e: finish((e, None)))
-            else:
-                loop.post(lambda r=result: finish((None, r)))
+                outcome = (e, None)
+            loop.post(lambda fin=finish, out=outcome: fin(out))
+
+    def depth(self) -> int:
+        """Jobs submitted but not yet finished (the encodeQueueDepth
+        gauge). GIL-atomic int reads; staleness is fine for a gauge."""
+        return self._depth
 
     def submit(self, fn, loop) -> Future:
         fut: Future = Future()
         loop.external_begin()  # loop must not exit while this is in flight
+        self._depth += 1
         self._q.put((fn, fut, loop))
         return fut
 
     def stop(self) -> None:
-        self._q.put(None)
+        for _ in range(self._n):
+            self._q.put(None)
 
 
 class Resolver:
@@ -93,11 +112,17 @@ class Resolver:
         **backend_kw,
     ):
         self.knobs = knobs or Knobs()
-        if backend in ("tpu", "tpu1", "mesh") and "capacity" not in backend_kw:
-            # thread the cluster capacity knob into the device index (the
+        if backend in ("tpu", "tpu1", "mesh"):
+            # thread the cluster knobs into the device index (the capacity
             # knob existed but never reached the backend — randomized sim
-            # runs silently tested the default capacity only)
-            backend_kw["capacity"] = self.knobs.CONFLICT_SET_CAPACITY
+            # runs silently tested the default capacity only); the
+            # occupancy thresholds drive the proactive reshard/grow
+            # decisions between batches
+            backend_kw.setdefault("capacity", self.knobs.CONFLICT_SET_CAPACITY)
+            backend_kw.setdefault(
+                "reshard_pressure", self.knobs.CONFLICT_RESHARD_PRESSURE
+            )
+            backend_kw.setdefault("grow_fill", self.knobs.CONFLICT_GROW_FILL)
         # device-fault injection (sim-only): seeded from the sim loop's RNG
         # under the CONFLICT_FAULT_INJECTION knob; chaos soaks arm the
         # named kernel-fault buggify sites through it (conflict/faults.py)
@@ -132,6 +157,13 @@ class Resolver:
         self.reply_gate = VersionGate(first_version)
         self.uid = uid
         self._exec: _SerialExecutor = None  # created lazily on a RealLoop
+        # dedicated encode executor (double buffering): batch N's host
+        # encode runs here while batch N-1's device scan occupies the
+        # device thread — the run loop never blocks on either.
+        # CONFLICT_ENCODE_THREADS=0 disables the overlap (encode runs
+        # inside the dispatch job on the device thread, the pre-PR shape)
+        self._encode_exec: _SerialExecutor = None
+        self.cs.encode_queue_fn = self._encode_queue_depth
         self._replies: dict[Version, ResolveBatchReply] = {}  # version → cached
         self._proxy_lrv: dict[str, Version] = {}  # proxy → last receive version
         # version → [(committed, mutations)] for system-keyspace txns —
@@ -200,6 +232,22 @@ class Resolver:
         return getattr(self.process, "address", "") if getattr(self, "process", None) else ""
 
     async def _resolve_traced(self, req, rsp, t_total) -> ResolveBatchReply:
+        # double buffering: batch N's host encode is submitted BEFORE the
+        # version-chain wait, so it runs on the encode executor while
+        # batch N-1's device scan is still in flight — the dispatch below
+        # deadline-waits on the future. A rebase/backend-swap between now
+        # and then surfaces as StaleEncodingError (re-encode + retry).
+        enc_fut = None
+        txns = None
+        if (
+            self._pipelined
+            and self.knobs.CONFLICT_ENCODE_THREADS > 0
+            and req.version not in self._replies
+        ):
+            txns = self._txns(req)
+            enc_fut = self._submit_encode(
+                lambda txns=txns: self.cs.encode(txns)
+            )
         # ordered application: wait for our turn in the version chain
         await self.gate.wait_until(req.prev_version)
         if rsp.sampled and now() > t_total:
@@ -223,14 +271,8 @@ class Resolver:
                 committed=[Verdict.CONFLICT] * len(req.transactions)
             )
 
-        txns = [
-            CommitTransaction(
-                read_snapshot=t.read_snapshot,
-                read_conflict_ranges=t.read_conflict_ranges,
-                write_conflict_ranges=t.write_conflict_ranges,
-            )
-            for t in req.transactions
-        ]
+        if txns is None:
+            txns = self._txns(req)
         self._sample_load(req.transactions)
         for t in req.transactions:
             if getattr(t, "debug_id", ""):
@@ -259,7 +301,7 @@ class Resolver:
                 )
             try:
                 verdicts = await self._dispatch_collect(
-                    req, txns, oldest, rsp, t_resolve
+                    req, txns, oldest, rsp, t_resolve, enc_fut
                 )
                 await self.reply_gate.wait_until(req.prev_version)
                 self.cs.note_ok()
@@ -355,6 +397,30 @@ class Resolver:
             self._exec = _SerialExecutor()
         return self._exec.submit(fn, loop)
 
+    def _submit_encode(self, fn) -> Future:
+        """Run ``fn`` on the encode executor (RealLoop; sized by
+        CONFLICT_ENCODE_THREADS) or inline (sim loops stay
+        single-threaded for determinism)."""
+        from ..runtime.loop import current_loop
+
+        loop = current_loop()
+        post = getattr(loop, "post", None)
+        if post is None or self.knobs.CONFLICT_ENCODE_THREADS <= 0:
+            fut: Future = Future()
+            try:
+                fut._set(fn())
+            except BaseException as e:
+                fut._set_error(e)
+            return fut
+        if self._encode_exec is None:
+            self._encode_exec = _SerialExecutor(
+                n_threads=self.knobs.CONFLICT_ENCODE_THREADS
+            )
+        return self._encode_exec.submit(fn, loop)
+
+    def _encode_queue_depth(self) -> int:
+        return self._encode_exec.depth() if self._encode_exec else 0
+
     def _make_injector(self):
         """Sim-only seeded kernel-fault injector (conflict/faults.py) when
         the CONFLICT_FAULT_INJECTION knob is on."""
@@ -396,36 +462,87 @@ class Resolver:
     def _abandon_executor(self) -> None:
         """A wedged device call may hold the serial executor's thread
         forever: drop it (daemon thread) and lazily build a fresh one, so
-        recovery and later batches never queue behind the hang."""
+        recovery and later batches never queue behind the hang. The encode
+        executor gets the same treatment — a deadline miss cannot tell
+        which side is wedged, and encode threads are as abandonable."""
         if self._exec is not None:
             ex, self._exec = self._exec, None
             ex.stop()  # parks a stop marker BEHIND the wedged job: harmless
+        if self._encode_exec is not None:
+            ex, self._encode_exec = self._encode_exec, None
+            ex.stop()
 
-    async def _dispatch_collect(self, req, txns, oldest, rsp, t_resolve):
+    def _txns(self, req) -> list:
+        return [
+            CommitTransaction(
+                read_snapshot=t.read_snapshot,
+                read_conflict_ranges=t.read_conflict_ranges,
+                write_conflict_ranges=t.write_conflict_ranges,
+            )
+            for t in req.transactions
+        ]
+
+    async def _dispatch_collect(self, req, txns, oldest, rsp, t_resolve, enc_fut):
         """Device dispatch/collect with a per-batch deadline
         (CONFLICT_DISPATCH_DEADLINE) and bounded in-place retry with
         backoff for transient faults. Retries happen BEFORE the gate
         advances, so no later batch has dispatched and version order is
         preserved; everything past the retry budget raises into
-        _recover_resolve."""
+        _recover_resolve. ``enc_fut`` is this batch's already-running
+        host encode (double buffering) — the deadline covers it too, and
+        a retry discards it (re-encode: the payload may be stale or from
+        a swapped backend)."""
         knobs = self.knobs
         deadline = now() + knobs.CONFLICT_DISPATCH_DEADLINE
+        async_encode = knobs.CONFLICT_ENCODE_THREADS > 0
 
-        def dispatch(txns=txns, version=req.version, oldest=oldest):
-            self.cs.prepare(version)  # version-base rebase window
-            enc = self.cs.encode(txns)
-            return self.cs.detect_many_encoded_async([(enc, version, oldest)])
-
-        # all conflict-set work runs on one serial executor (RealLoop)
-        # or inline (sim): dispatch jobs enqueue in gate order here,
-        # collect jobs interleave behind later dispatches — so the
+        # all device-facing conflict-set work runs on one serial executor
+        # (RealLoop) or inline (sim): dispatch jobs enqueue in gate order
+        # here, collect jobs interleave behind later dispatches — so the
         # device pipelines across batches while the loop never blocks
         # on a device wait (a first-shape compile can outlast
-        # FAILURE_TIMEOUT and flap the whole worker otherwise)
+        # FAILURE_TIMEOUT and flap the whole worker otherwise). Host
+        # encode runs on the SEPARATE encode executor so it overlaps the
+        # device scan instead of queueing behind it.
         attempt = 0
         while True:
             t_attempt = now()
             try:
+                if async_encode:
+                    if enc_fut is None:
+                        enc_fut = self._submit_encode(
+                            lambda: self.cs.encode(txns)
+                        )
+                    t_need = now()
+                    enc, enc_s = await self._deadline_wait(enc_fut, deadline)
+                    # encode-overlap evidence: of enc_s seconds of host
+                    # encode, only the wait just paid was on the critical
+                    # path — the rest hid behind the device scan
+                    self.cs.note_encode_overlap(enc_s, now() - t_need)
+                    # injected encode-side stall (sim): a wedged encode
+                    # thread rides under — or hits — the same deadline
+                    stall = self.cs.take_stall()
+                    if stall:
+                        waiter = (
+                            Future() if stall == float("inf") else delay(stall)
+                        )
+                        await self._deadline_wait(waiter, deadline)
+
+                    def dispatch(enc=enc, version=req.version, oldest=oldest):
+                        self.cs.prepare(version)  # version-base rebase window
+                        return self.cs.detect_many_encoded_async(
+                            [(enc, version, oldest)]
+                        )
+
+                else:  # legacy shape: encode inside the dispatch job
+
+                    def dispatch(txns=txns, version=req.version, oldest=oldest):
+                        self.cs.prepare(version)
+                        enc, _enc_s = self.cs.encode(txns)
+                        return self.cs.detect_many_encoded_async(
+                            [(enc, version, oldest)]
+                        )
+
                 handle = await self._deadline_wait(
                     self._submit(dispatch), deadline
                 )
@@ -433,6 +550,7 @@ class Resolver:
             except Cancelled:
                 raise
             except KernelFaultError as e:
+                enc_fut = None  # stale/failed encode: next attempt re-encodes
                 if not e.transient or attempt >= knobs.CONFLICT_DISPATCH_RETRIES:
                     raise
                 attempt += 1
@@ -512,10 +630,14 @@ class Resolver:
         return verdicts
 
     def close(self) -> None:
-        """Retire the role (worker._destroy): stop the device thread."""
+        """Retire the role (worker._destroy): stop the device + encode
+        threads."""
         if self._exec is not None:
             self._exec.stop()
             self._exec = None
+        if self._encode_exec is not None:
+            self._encode_exec.stop()
+            self._encode_exec = None
 
     # -- load sampling / repartitioning (resolutionBalancing) ------------------
 

@@ -111,7 +111,19 @@ def snapshot(result, platform):
         json.dump(entry, f, indent=1, default=str)
         f.write("\n")
     os.replace(tmp, PARTIAL)
-    log("snapshot: vs_baseline=%s -> %s" % (entry.get("vs_baseline"), PARTIAL))
+    # ratio + its denominator + the shape, on one line (ROADMAP standing
+    # guidance: a vs_baseline without native_txn_s/shape is ambiguous —
+    # the native baseline drifts ±18% and only 200x2500 compares across
+    # rounds)
+    log(
+        "snapshot: vs_baseline=%s (native_txn_s=%s, shape=%s) -> %s"
+        % (
+            entry.get("vs_baseline"),
+            entry.get("native_txn_s"),
+            entry.get("shape"),
+            PARTIAL,
+        )
+    )
     # kernel counter provenance (bench.py embeds its KernelMetrics
     # snapshot): a capture that paid overflow replays or reshard churn
     # says so next to its number

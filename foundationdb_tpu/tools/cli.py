@@ -217,12 +217,18 @@ class FdbCli:
                     k = snap.get("kernel") or {}
                     occ = (k.get("occupancy") or {}) if k else {}
                     h = (k.get("health") or {}) if k else {}
+                    ov = (k.get("encodeOverlapSeconds") or {}) if k else {}
                     extra = (
                         f"  kernel: {occ.get('liveRows', 0)} rows "
                         f"{occ.get('fillFraction', 0):.1%} full, "
                         f"{k.get('overflowReplays', 0)} replays, "
                         f"{k.get('reshardsDevice', 0)}+"
-                        f"{k.get('reshardsHost', 0)} reshards"
+                        f"{k.get('reshardsHost', 0)} reshards "
+                        f"({k.get('reshardsProactive', 0)} proactive), "
+                        f"enc overlap p50 "
+                        f"{1000 * (ov.get('p50') or 0):.2f} ms "
+                        f"over {ov.get('count', 0)}, "
+                        f"encQ {k.get('encodeQueueDepth', 0)}"
                         if occ
                         else ""
                     )

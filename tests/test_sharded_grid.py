@@ -153,10 +153,14 @@ def test_sharded_reshard_on_overflow():
         txs = _make_txns(rnd, T, 120, i, span=2)
         want = oracle.detect_batch(list(txs), i + 20, max(i - 4, 0))
         batch = _encode_batch(txs, width, T, KR, KW)
-        snapshot = jax.tree.map(lambda x: x + 0, states)
+        # donation discipline (PR 2's donated-buffer race): the snapshot
+        # keeps the ORIGINAL arrays — step() donates a fresh `+ 0` copy,
+        # so an abandoned overflow dispatch can never scribble over the
+        # buffers the replay reads
+        snapshot = states
         for _attempt in range(8):
             new_states, verdicts, pressure = step(
-                states,
+                jax.tree.map(lambda x: x + 0, states),
                 batch,
                 np.int32(i + 20),
                 np.int32(max(i - 4, 0)),
@@ -183,7 +187,7 @@ def test_sharded_reshard_on_overflow():
             states = jax.device_put(
                 jax.tree.map(lambda *xs: np.stack(xs), *parts), spec
             )
-            snapshot = jax.tree.map(lambda x: x + 0, states)
+            snapshot = states
             grown = {p: (Bc, Sc) for p in range(n_part)}
         else:
             raise AssertionError("overflow replay did not converge")
