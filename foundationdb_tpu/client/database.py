@@ -74,6 +74,11 @@ class Database:
         self._locations = KeyRangeMap(default=None)
         # GRV batcher (readVersionBatcher, NativeAPI.actor.cpp:1290)
         self._grv_batcher = RequestBatcher(self._fetch_grv, self.client.spawn)
+        # same-tick read coalescing into storage multiGet batches
+        # (client/read_coalescer.py; CLIENT_READ_COALESCING gates use)
+        from .read_coalescer import ReadCoalescer
+
+        self.reads = ReadCoalescer(self)
         if coordinators:
             self.client.spawn(self._monitor_proxies(coordinators))
 

@@ -10,13 +10,17 @@ the healthy replica instead of a stalled one.
 
 from __future__ import annotations
 
-from ..errors import WrongShardServer
+from ..errors import FutureVersion, WrongShardServer
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import delay, settled, wait_for_any
 from ..runtime.loop import Cancelled, now
-from ..runtime.trace import span
+from ..runtime.trace import annotate as _annotate, span
 
 _ROTATE = (BrokenPromise, WrongShardServer)
+
+MAX_READ_ATTEMPTS = 60
+MAX_VERSION_RETRIES = 20
+FUTURE_VERSION_RETRY_DELAY = 0.05
 
 
 class QueueData:
@@ -140,3 +144,43 @@ async def load_balanced_request(db, team, token: str, req, hedge: bool = True):
                 raise
         i += advanced
     raise last_err or BrokenPromise("no replica answered")
+
+
+async def load_balanced_read(db, key: bytes, token: str, req, before=False):
+    """A whole storage read: locate the key's team (cached), load-balance
+    the request across it, and retry through the standard failure modes —
+    future_version backs off and re-asks (the storage will catch up),
+    BrokenPromise / wrong_shard_server drop the location cache and
+    re-locate (NativeAPI's getValue/getRange handling). The retry policy
+    Transaction reads and the coalescer's per-key fallback share.
+
+    ``before`` targets the shard holding the keys immediately BELOW
+    ``key`` (backward selector walks / reverse scans)."""
+    from ..runtime.buggify import buggify
+
+    version_retries = 0
+    last_err: Exception = None
+    if buggify():
+        db.invalidate_cache(key, before=before)  # stale-location path
+    for attempt in range(MAX_READ_ATTEMPTS):
+        if before:
+            _b, _e, team = await db._locate_before(key)
+        else:
+            _b, _e, team = await db._locate(key)
+        try:
+            return await load_balanced_request(db, team, token, req)
+        except FutureVersion as e:
+            last_err = e
+            version_retries += 1
+            if version_retries > MAX_VERSION_RETRIES:
+                raise
+            _annotate("ClientReadRetry", "client", Err="FutureVersion")
+            await delay(FUTURE_VERSION_RETRY_DELAY)
+        except (BrokenPromise, WrongShardServer) as e:
+            # whole team unreachable or moved: drop cache, back off,
+            # re-locate
+            last_err = e
+            _annotate("ClientReadRetry", "client", Err=type(e).__name__)
+            db.invalidate_cache(key, before=before)
+            await delay(0.1)
+    raise last_err or BrokenPromise("read retries exhausted")

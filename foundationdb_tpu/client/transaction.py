@@ -24,19 +24,17 @@ from ..errors import (
     AccessedUnreadable,
     CommitUnknownResult,
     FdbError,
-    FutureVersion,
     NotCommitted,
     TransactionTooOld,
-    WrongShardServer,
 )
 from ..kv.atomic import apply_atomic
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
 from ..kv.selector import SELECTOR_END, KeySelector, as_selector
-from ..net.sim import BrokenPromise, Endpoint
+from ..net.sim import BrokenPromise
 from ..runtime.futures import delay
-from ..runtime.trace import NULL_SPAN as _NO_SPAN, annotate as _annotate
-from .loadbalance import load_balanced_request
+from ..runtime.trace import NULL_SPAN as _NO_SPAN
+from .loadbalance import load_balanced_read
 from ..runtime.buggify import buggify
 from ..server.interfaces import (
     CommitRequest,
@@ -49,9 +47,6 @@ from ..server.interfaces import (
 )
 
 MAX_FIND_KEY_HOPS = 10000  # findKey shard hops (a loop here is a bug)
-
-MAX_READ_ATTEMPTS = 60
-FUTURE_VERSION_RETRY_DELAY = 0.05
 
 
 def strinc(key: bytes) -> bytes:
@@ -328,18 +323,23 @@ class Transaction:
                 if k >= SELECTOR_END:
                     return SELECTOR_END
                 before = False
-                s_begin, s_end, _team = await self.db._locate(k)
+                s_begin, s_end, team = await self.db._locate(k)
             else:
                 if k <= b"":
                     return b""
                 before = True
-                s_begin, s_end, _team = await self.db._locate_before(k)
+                s_begin, s_end, team = await self.db._locate_before(k)
             req = GetKeyRequest(
                 key=k, offset=off, version=version, begin=s_begin, end=s_end
             )
-            reply = await self._load_balanced(
-                k, Tokens.GET_KEY, req, before=before
-            )
+            if self.db.reads.enabled():
+                # the resolution hop batches with the tick's other reads;
+                # partial-resolution replies keep driving this walk
+                reply = await self.db.reads.get_key(team, version, req)
+            else:
+                reply = await self._load_balanced(
+                    k, Tokens.GET_KEY, req, before=before
+                )
             if reply.resolved:
                 return reply.key
             k, off = reply.key, reply.offset
@@ -464,6 +464,13 @@ class Transaction:
 
     async def _storage_get(self, key: bytes) -> Optional[bytes]:
         version = await self.get_read_version()
+        if self.db.reads.enabled():
+            # same-tick coalescing: this get joins the tick's multiGet
+            # batch for the key's team (client/read_coalescer.py); RYW
+            # overlay and conflict accounting already happened per-key in
+            # _get_impl, so only the storage fetch batches
+            _b, _e, team = await self.db._locate(key)
+            return await self.db.reads.get(team, version, key)
         req = GetValueRequest(key=key, version=version)
         reply = await self._load_balanced(key, Tokens.GET_VALUE, req)
         return reply.value
@@ -473,12 +480,15 @@ class Transaction:
         where the next window starts, or None when [lo, hi) is fully
         covered by this reply (shard splits + `more` both advance it)."""
         version = await self.get_read_version()
-        s_begin, s_end, _team = await self.db._locate(lo)
+        s_begin, s_end, team = await self.db._locate(lo)
         chunk_hi = hi if s_end is None else min(hi, s_end)
         if buggify():
             limit = 1  # one-row windows: worst-case RYW window merging
         req = GetKeyValuesRequest(begin=lo, end=chunk_hi, version=version, limit=limit)
-        reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
+        if self.db.reads.enabled():
+            reply = await self.db.reads.get_range(team, version, req)
+        else:
+            reply = await self._load_balanced(lo, Tokens.GET_KEY_VALUES, req)
         if reply.more:
             return reply.data, key_after(reply.data[-1][0])
         if chunk_hi < hi:
@@ -490,12 +500,15 @@ class Transaction:
         ``hi`` (NativeAPI's reverse getRange). next_hi bounds the next
         window, or None when [lo, hi) is fully covered by this reply."""
         version = await self.get_read_version()
-        s_begin, _s_end, _team = await self.db._locate_before(hi)
+        s_begin, _s_end, team = await self.db._locate_before(hi)
         chunk_lo = max(lo, s_begin)
         req = GetKeyValuesRequest(
             begin=chunk_lo, end=hi, version=version, limit=limit, reverse=True
         )
-        reply = await self._load_balanced(chunk_lo, Tokens.GET_KEY_VALUES, req)
+        if self.db.reads.enabled():
+            reply = await self.db.reads.get_range(team, version, req)
+        else:
+            reply = await self._load_balanced(chunk_lo, Tokens.GET_KEY_VALUES, req)
         if reply.more:
             return reply.data, reply.data[-1][0]
         if chunk_lo > lo:
@@ -504,38 +517,14 @@ class Transaction:
 
     async def _load_balanced(self, key: bytes, token: str, req, before=False):
         """Replica selection with retry — LoadBalance.actor.h:158.
-        Per-replica latency/penalty model + hedged second request
-        (client/loadbalance.py); wrong_shard_server or a dead team
-        refreshes the location cache — NativeAPI's handling in
-        getValue/getRange. ``before`` targets the shard holding the keys
-        immediately BELOW ``key`` (backward selector walks / reverse
-        scans — NativeAPI's isBackward location lookups)."""
-        version_retries = 0
-        last_err: Exception = None
-        if buggify():
-            self.db.invalidate_cache(key, before=before)  # stale-location path
-        for attempt in range(MAX_READ_ATTEMPTS):
-            if before:
-                _b, _e, team = await self.db._locate_before(key)
-            else:
-                _b, _e, team = await self.db._locate(key)
-            try:
-                return await load_balanced_request(self.db, team, token, req)
-            except FutureVersion as e:
-                last_err = e
-                version_retries += 1
-                if version_retries > 20:
-                    raise
-                _annotate("ClientReadRetry", "client", Err="FutureVersion")
-                await delay(FUTURE_VERSION_RETRY_DELAY)
-            except (BrokenPromise, WrongShardServer) as e:
-                # whole team unreachable or moved: drop cache, back off,
-                # re-locate
-                last_err = e
-                _annotate("ClientReadRetry", "client", Err=type(e).__name__)
-                self.db.invalidate_cache(key, before=before)
-                await delay(0.1)
-        raise last_err or BrokenPromise("read retries exhausted")
+        Per-replica latency/penalty model + hedged second request,
+        wrong_shard_server / dead-team location-cache refresh: the whole
+        policy lives in client/loadbalance.py (load_balanced_read) so the
+        read coalescer's per-key fallback shares it verbatim. ``before``
+        targets the shard holding the keys immediately BELOW ``key``
+        (backward selector walks / reverse scans — NativeAPI's isBackward
+        location lookups)."""
+        return await load_balanced_read(self.db, key, token, req, before=before)
 
     # -- commit ----------------------------------------------------------------
 

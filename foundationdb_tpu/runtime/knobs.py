@@ -114,6 +114,17 @@ class Knobs:
     CLIENT_COMMIT_SAMPLE = 0.0
     GRV_BATCH_INTERVAL = 0.0005
     CLIENT_MAX_RETRY_DELAY = 1.0
+    # read pipeline (ISSUE 12 / ROADMAP item 1): same-tick coalescing of
+    # client reads into storage multiGet/multiGetRange batches
+    # (client/read_coalescer.py). Off = every read is its own RPC (the
+    # pre-pipeline shape; the differential battery runs both).
+    CLIENT_READ_COALESCING = True
+    CLIENT_MULTIGET_MAX_KEYS = 1024  # entries per batched request
+    # batched reads a storage connection keeps in flight per team before
+    # new reads queue into the next batch (read pipelining, not
+    # stop-and-wait: batch N+1 dispatches while batch N's reply is on
+    # the wire)
+    CLIENT_READ_PIPELINE_DEPTH = 4
     # simulation (Sim2's latency model: MIN + FAST·a almost always, rare
     # tail to MAX — flow/Knobs.cpp:106-108, sim2.actor.cpp:1618)
     SIM_MIN_LATENCY = 0.0001
@@ -236,3 +247,18 @@ class Knobs:
             self.GETCOMMITVERSION_TIMEOUT,
             self.MASTER_VERSION_GAP_TIMEOUT + 2.0,
         )
+
+    def randomize_read_pipeline(self, rng) -> None:
+        """Read-pipeline knob randomization, kept OUT of randomize():
+        the chaos soak's cluster shapes and workload rotation draw from
+        the same stream right after randomize(), so new draws there would
+        silently reshuffle every pinned soak seed. The soak calls this at
+        the END of its draw sequence instead (tools/soak.py)."""
+        if rng.coinflip(0.25):
+            # both read paths stay exercised across the soak matrix
+            self.CLIENT_READ_COALESCING = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            # tiny batches force the chunking path; tiny depth forces queuing
+            self.CLIENT_MULTIGET_MAX_KEYS = rng.random_choice([2, 64, 1024])
+        if rng.coinflip(0.25):
+            self.CLIENT_READ_PIPELINE_DEPTH = rng.random_choice([1, 2, 8])

@@ -20,7 +20,9 @@ import struct
 # gen 3: TransactionData.debug_id (transaction debug chains)
 # gen 4: request tuples carry a span-context envelope field
 #        (distributed tracing; net/tcp.py "req" messages)
-PROTOCOL_VERSION = 0x0FDB00B070010004
+# gen 5: batched read pipeline — storage.multiGet / storage.multiGetRange
+#        endpoints and their MultiGet*Request/Reply shapes (ISSUE 12)
+PROTOCOL_VERSION = 0x0FDB00B070010005
 
 
 class BinaryWriter:

@@ -609,6 +609,14 @@ class ClusterController:
                 "bytes_read": sq("bytesQueried"),
                 "writes": tx("mutations"),
                 "bytes_written": tx("mutationBytes"),
+                # read pipeline (ISSUE 12): reads that arrived batched
+                # (multiGet/multiGetRange entries) and the batch rate —
+                # reads_batched/reads is the coalescing ratio
+                "reads_batched": sq("multiGetKeys"),
+                "multiget_batches": sq("multiGetBatches"),
+                "multiget_range_batches": sq("multiGetRangeBatches"),
+                "index_reads": sq("multiGetIndexKeys"),
+                "index_fallbacks": sq("multiGetFallbackKeys"),
             },
             "latency_bands": {
                 "grv": band_agg("proxy", "grvLatencyBands"),

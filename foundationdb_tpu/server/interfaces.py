@@ -267,6 +267,63 @@ class GetKeyValuesReply:
     more: bool = False
 
 
+# -- batched reads (ISSUE 12: the read pipeline's wire shapes) -----------------
+#
+# Per-entry error codes a batched reply may carry. A batched endpoint
+# answers every entry it can and reports the rest individually, so one
+# bad key cannot fail a whole batch:
+#   too_old     — definitive: that entry's read is below the MVCC window
+#                 (only reachable per-entry via fault injection; a version
+#                 genuinely below the window fails the batch up front)
+#   wrong_shard — this server can't serve that entry at the version; the
+#                 client re-locates and retries it per-key
+#   dropped     — the reply for that entry was lost (fault injection /
+#                 partial reply); the client degrades it to a per-key read
+READ_ERR_TOO_OLD = "too_old"
+READ_ERR_WRONG_SHARD = "wrong_shard"
+READ_ERR_DROPPED = "dropped"
+
+
+@dataclass
+class MultiGetRequest:
+    """Many point reads — and selector resolutions — against ONE version
+    in one RPC (the client's same-tick read coalescing; the storage
+    answers engine misses through TpuRangeIndex.batch_lookup in one
+    kernel and pays waitVersion once for the whole batch)."""
+
+    keys: list[bytes] = field(default_factory=list)
+    # normalized selector resolutions riding the same hop, each in the
+    # GetKeyRequest shape: (key, offset, begin, end)
+    selectors: list = field(default_factory=list)
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class MultiGetReply:
+    values: list = field(default_factory=list)  # per key: value | None
+    errors: list = field(default_factory=list)  # [(key index, READ_ERR_*)]
+    selectors: list = field(default_factory=list)  # per selector: GetKeyReply
+    selector_errors: list = field(default_factory=list)  # [(index, READ_ERR_*)]
+
+
+@dataclass
+class MultiGetRangeRequest:
+    """Several range reads against ONE version in one RPC — the
+    multiGetRange sibling of getRange; the storage resolves every
+    forward range's engine bounds with one TpuRangeIndex.batch_range
+    interval query."""
+
+    # (begin, end, limit, reverse) per range
+    ranges: list = field(default_factory=list)
+    version: Version = INVALID_VERSION
+
+
+@dataclass
+class MultiGetRangeReply:
+    results: list = field(default_factory=list)  # per range: GetKeyValuesReply|None
+    errors: list = field(default_factory=list)  # [(range index, READ_ERR_*)]
+
+
 # -- role interfaces (the *Interface.h structs): address + instance uid -------
 #
 # A role instance registers its handlers under "{token}#{uid}" so many
@@ -459,6 +516,8 @@ class Tokens:
     GET_SPLIT_KEY = "storage.getSplitKey"
     WATCH_VALUE = "storage.watchValue"
     BATCH_GET = "storage.batchGet"
+    MULTI_GET = "storage.multiGet"
+    MULTI_GET_RANGE = "storage.multiGetRange"
     # worker
     WORKER_RECRUIT = "worker.recruit"
     WORKER_SET_DB_INFO = "worker.setDBInfo"

@@ -37,6 +37,12 @@ from .interfaces import (
     GetKeyValuesRequest,
     GetValueReply,
     GetValueRequest,
+    MultiGetRangeReply,
+    MultiGetRangeRequest,
+    MultiGetReply,
+    MultiGetRequest,
+    READ_ERR_DROPPED,
+    READ_ERR_WRONG_SHARD,
     Tokens,
     Version,
     WatchValueReply,
@@ -122,6 +128,23 @@ class StorageServer:
         # exact per-endpoint histogram next to the sampled percentiles
         # (FDB's readLatencyBands proper)
         self._b_read = self.stats.bands("readLatencyBands")
+        # read pipeline (ISSUE 12): batched-read accounting — batch and
+        # entry totals, entries-per-batch distribution, engine misses
+        # answered by the range-index kernel vs per-key fallbacks, and
+        # the batched interval-query's time (model time in sim, wall on a
+        # real server)
+        self._c_mg_batches = self.stats.counter("multiGetBatches")
+        self._c_mg_keys = self.stats.counter("multiGetKeys")
+        self._c_mgr_batches = self.stats.counter("multiGetRangeBatches")
+        self._c_mgr_ranges = self.stats.counter("multiGetRangeRanges")
+        self._c_mg_index = self.stats.counter("multiGetIndexKeys")
+        self._c_mg_fallback = self.stats.counter("multiGetFallbackKeys")
+        self._l_mg_size = self.stats.latency("multiGetEntriesPerBatch")
+        self._l_batch_range = self.stats.latency("batchRangeSeconds")
+        # sim-only read-fault hook: fn(request, reply) → mutated reply
+        # (drop / partial / too_old on a subset; tests + chaos soak prove
+        # the client degrades to per-key reads without losing RYW)
+        self._read_fault_injector = None
         self.stats.gauge("version", lambda: self.version.get())
         self.stats.gauge("durableVersion", lambda: self.durable_version)
         self.stats.gauge(
@@ -753,8 +776,14 @@ class StorageServer:
         await self._wait_for_version(req.version)
         if sp.sampled and now() > t_wait:
             emit_span("Storage.waitVersion", self._proc_addr(), sp, t_wait, now())
-        k, off = req.key, req.offset
         self._c_queries.add()
+        return self._get_key_at(req)
+
+    def _get_key_at(self, req: GetKeyRequest) -> GetKeyReply:
+        """Post-version-gate selector resolution core, shared by the
+        per-key getKey endpoint and multiGet's batched selector entries
+        (which pay waitVersion once for the whole batch)."""
+        k, off = req.key, req.offset
         before = off < 1
         o_begin, o_end = self._owned_span(k, req.version, before=before)
         # clamp to the CLIENT's located shard: a tag-routed server (static
@@ -787,9 +816,13 @@ class StorageServer:
             return GetKeyReply(key=b"", resolved=True)
         return GetKeyReply(key=s_begin, offset=off + len(rows), resolved=False)
 
-    def _read_range_merged(self, begin, end, version, limit, reverse):
+    def _read_range_merged(self, begin, end, version, limit, reverse,
+                           engine_bounds=None):
         """Window-over-engine merge (the reference's readRange:916 merge of
-        the in-memory versioned tree with the durable engine)."""
+        the in-memory versioned tree with the durable engine).
+        ``engine_bounds``: precomputed index row bounds for this range
+        (multiGetRange resolves every range's bounds in one batched
+        interval query)."""
         if self.engine is None:
             return self.data.range(
                 begin, end, version, limit=limit, reverse=reverse
@@ -800,7 +833,7 @@ class StorageServer:
             return self._merged_reverse(begin, end, overlay, limit)
         want = limit + len(win) + 1
         while True:
-            base = self._engine_range(begin, end, want)
+            base = self._engine_range(begin, end, want, bounds=engine_bounds)
             # the engine's local metadata rows (\xff\xff/local/...) are
             # not data — they must not leak into client scans or fetchKeys
             merged = {
@@ -856,12 +889,15 @@ class StorageServer:
 
         return not isinstance(current_loop(), RealLoop)
 
-    def _engine_range(self, begin, end, want):
+    def _engine_range(self, begin, end, want, bounds=None):
         """Durable-engine range rows, routed through the TPU range index
         when it is on (the snapshot's [lo, hi) row bounds come from the
         batched searchsorted kernel; rows materialize from the engine's
         sorted key list) — getRange coverage for the read-path index,
-        falling back to the engine's own bisect otherwise.
+        falling back to the engine's own bisect otherwise. ``bounds``
+        short-circuits the kernel query with row bounds the caller
+        already resolved in a batched interval query (multiGetRange);
+        they are valid only while no await has interleaved since.
 
         Codes are truncated, so the index bounds are approximate at code
         collisions: lo never overshoots (order-preserving codes) but the
@@ -870,45 +906,48 @@ class StorageServer:
         run and post-filtered against the REAL byte keys."""
         idx = self._range_index
         keys_list = self.engine._keys
-        if idx is not None and idx.n == len(keys_list):
+        if bounds is None:
+            if idx is None or idx.n != len(keys_list):
+                return self.engine.read_range(begin, end, limit=want)
+            t0 = now()
             lo, hi = idx.batch_range([begin], [end])
             lo, hi = int(lo[0]), int(hi[0])
-            out = []
-            j = lo
-            n = len(keys_list)
-            while j < n and (j < hi or keys_list[j] < end):
-                k = keys_list[j]
-                if k >= end:
+            self._l_batch_range.add(now() - t0)
+        else:
+            lo, hi = bounds
+        out = []
+        j = lo
+        n = len(keys_list)
+        while j < n and (j < hi or keys_list[j] < end):
+            k = keys_list[j]
+            if k >= end:
+                break
+            if k >= begin:
+                out.append((k, self.engine._map[k]))
+                if len(out) >= want:
                     break
-                if k >= begin:
-                    out.append((k, self.engine._map[k]))
-                    if len(out) >= want:
-                        break
-                j += 1
-            return out
-        return self.engine.read_range(begin, end, limit=want)
-
-    async def batch_get(self, req):
-        """Many point reads in ONE request: window hits answer locally;
-        engine misses resolve through the TPU range-index snapshot in one
-        vectorized lookup (SURVEY.md's batched read-path primitive).
-        req = (keys, version) → [value | None]."""
-        keys, version = req
-        t0 = now()
-        with span(
-            "Storage.batchGet", self._proc_addr(), storage=self.uid, keys=len(keys)
-        ):
-            out = await self._batch_get_impl(keys, version)
-        dt = now() - t0
-        self._b_read.add(dt)
+            j += 1
         return out
 
-    async def _batch_get_impl(self, keys, version):
-        await self._wait_for_version(version)
+    # -- batched reads (ISSUE 12: the read pipeline's storage half) ------------
+
+    def _multi_get_at(self, keys, version):
+        """Point-read core shared by multiGet and the legacy batchGet:
+        window hits answer locally; engine misses resolve through the
+        TPU range-index snapshot in ONE vectorized kernel lookup
+        (SURVEY.md's batched read-path primitive), falling back per-key
+        while the index is off or mid-rebuild. Returns
+        (values, [(index, READ_ERR_*)]) — runs after the batch's single
+        waitVersion, with no awaits (index and engine stay in lockstep)."""
         out = [None] * len(keys)
+        errors = []
         misses, miss_idx = [], []
         for i, k in enumerate(keys):
-            self._check_read(k, k + b"\x00", version)
+            try:
+                self._check_read(k, k + b"\x00", version)
+            except WrongShardServer:
+                errors.append((i, READ_ERR_WRONG_SHARD))
+                continue
             known, v = self.data.get_with_presence(k, version)
             if known:
                 out[i] = v
@@ -916,14 +955,195 @@ class StorageServer:
                 misses.append(k)
                 miss_idx.append(i)
         if misses:
-            if self._range_index is not None:
-                _idx, found = self._range_index.batch_lookup(misses)
+            idx = self._range_index
+            if idx is not None and idx.n == len(self.engine._keys):
+                t0 = now()
+                _rows, found = idx.batch_lookup(misses)
+                self._l_batch_range.add(now() - t0)
+                self._c_mg_index.add(len(misses))
                 for j, i in enumerate(miss_idx):
                     if found[j]:
                         out[i] = self.engine._map.get(misses[j])
             else:
+                self._c_mg_fallback.add(len(misses))
                 for j, i in enumerate(miss_idx):
                     out[i] = self.engine.read_value(misses[j])
+        return out, errors
+
+    async def multi_get(self, req: MultiGetRequest) -> MultiGetReply:
+        """The read pipeline's point endpoint: many gets — and selector
+        resolutions — at ONE version in one RPC. waitVersion is paid once
+        for the whole batch; per-entry failures come back as READ_ERR_*
+        codes so one bad key fails only its own future (the client
+        degrades it to a per-key read)."""
+        t0 = now()
+        n = len(req.keys) + len(req.selectors)
+        with span(
+            "Storage.multiGet", self._proc_addr(), storage=self.uid,
+            keys=len(req.keys), selectors=len(req.selectors),
+        ) as sp:
+            if buggify():
+                await delay(0.001)  # slow replica (hedging/load-balance paths)
+            self._c_mg_batches.add()
+            self._c_mg_keys.add(n)
+            self._l_mg_size.add(float(n))
+            t_wait = now()
+            await self._wait_for_version(req.version)
+            if sp.sampled and now() > t_wait:
+                emit_span(
+                    "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
+                )
+            t_eng = now()
+            values, errors = self._multi_get_at(req.keys, req.version)
+            sel_replies, sel_errors = [], []
+            for i, sel in enumerate(req.selectors):
+                key, offset, begin, end = sel
+                greq = GetKeyRequest(
+                    key=key, offset=offset, version=req.version,
+                    begin=begin, end=end,
+                )
+                try:
+                    sel_replies.append(self._get_key_at(greq))
+                except WrongShardServer:
+                    sel_replies.append(None)
+                    sel_errors.append((i, READ_ERR_WRONG_SHARD))
+            if sp.sampled:
+                emit_span(
+                    "Storage.engine", self._proc_addr(), sp, t_eng, now(),
+                    keys=n,
+                )
+                sp.event("StorageRead", kind="ReadDebug")
+            reply = MultiGetReply(
+                values=values, errors=errors,
+                selectors=sel_replies, selector_errors=sel_errors,
+            )
+            inj = self._read_fault_injector
+            if inj is not None:
+                reply = inj(req, reply) or reply
+            if buggify() and reply.values:
+                # batched-read chaos: lose one entry — the client must
+                # degrade exactly that key to the per-key path
+                reply.errors = list(reply.errors) + [
+                    (len(reply.values) - 1, READ_ERR_DROPPED)
+                ]
+        dt = now() - t0
+        self._c_queries.add(n)
+        self._l_read.add(dt)
+        self._b_read.add(dt)
+        for i, v in enumerate(reply.values):
+            if v is not None:
+                self._c_rows.add()
+                self._c_bytes_q.add(len(req.keys[i]) + len(v))
+        return reply
+
+    async def multi_get_range(
+        self, req: MultiGetRangeRequest
+    ) -> MultiGetRangeReply:
+        """getRange's multi sibling: several range windows at ONE version
+        in one RPC. waitVersion once; every forward range's engine row
+        bounds come from ONE TpuRangeIndex.batch_range interval query
+        instead of N engine walks; reverse ranges keep the bounded
+        backward walk per range."""
+        t0 = now()
+        with span(
+            "Storage.multiGetRange", self._proc_addr(), storage=self.uid,
+            ranges=len(req.ranges),
+        ) as sp:
+            self._c_mgr_batches.add()
+            self._c_mgr_ranges.add(len(req.ranges))
+            self._l_mg_size.add(float(len(req.ranges)))
+            t_wait = now()
+            await self._wait_for_version(req.version)
+            if sp.sampled and now() > t_wait:
+                emit_span(
+                    "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
+                )
+            t_eng = now()
+            bounds = self._multi_engine_bounds(req.ranges)
+            results, errors = [], []
+            rows_total = 0
+            for i, rng in enumerate(req.ranges):
+                begin, end, limit, reverse = rng
+                try:
+                    self._check_read(begin, end, req.version)
+                except WrongShardServer:
+                    results.append(None)
+                    errors.append((i, READ_ERR_WRONG_SHARD))
+                    continue
+                # tiny replies force every caller through its `more` path
+                limit_i = 1 if buggify() else limit
+                data = self._read_range_merged(
+                    begin, end, req.version, limit_i + 1, reverse,
+                    engine_bounds=None if bounds is None else bounds[i],
+                )
+                more = len(data) > limit_i
+                results.append(GetKeyValuesReply(data=data[:limit_i], more=more))
+                rows_total += min(len(data), limit_i)
+                self._c_rows.add(min(len(data), limit_i))
+                self._c_bytes_q.add(
+                    sum(len(k) + len(v) for k, v in data[:limit_i])
+                )
+            if sp.sampled:
+                emit_span(
+                    "Storage.engine", self._proc_addr(), sp, t_eng, now(),
+                    rows=rows_total,
+                )
+                sp.event("StorageRead", kind="ReadDebug")
+            reply = MultiGetRangeReply(results=results, errors=errors)
+            inj = self._read_fault_injector
+            if inj is not None:
+                reply = inj(req, reply) or reply
+        dt = now() - t0
+        self._c_queries.add(len(req.ranges))
+        self._l_read.add(dt)
+        self._b_read.add(dt)
+        return reply
+
+    def _multi_engine_bounds(self, ranges):
+        """Per-range [lo, hi) engine row bounds from ONE batched interval
+        query — the KeyRangeMap/readRange range lookups of the whole
+        batch through the XLA searchsorted kernel (the secondary north
+        star). None when the index can't serve (off / mid-rebuild / no
+        engine); reverse ranges get None entries (bounded backward walk
+        per range)."""
+        idx = self._range_index
+        if (
+            not ranges
+            or self.engine is None
+            or idx is None
+            or idx.n != len(self.engine._keys)
+        ):
+            return None
+        fwd = [i for i, r in enumerate(ranges) if not r[3]]
+        if not fwd:
+            return None
+        t0 = now()
+        los, his = idx.batch_range(
+            [ranges[i][0] for i in fwd], [ranges[i][1] for i in fwd]
+        )
+        self._l_batch_range.add(now() - t0)
+        self._c_mg_index.add(len(fwd))
+        out = [None] * len(ranges)
+        for j, i in enumerate(fwd):
+            out[i] = (int(los[j]), int(his[j]))
+        return out
+
+    async def batch_get(self, req):
+        """Legacy many-point-reads endpoint, now a thin adapter over the
+        shared multiGet core (one batched read path to maintain).
+        req = (keys, version) → [value | None]; any unservable key fails
+        the whole request (the historical contract)."""
+        keys, version = req
+        t0 = now()
+        with span(
+            "Storage.batchGet", self._proc_addr(), storage=self.uid, keys=len(keys)
+        ):
+            await self._wait_for_version(version)
+            out, errors = self._multi_get_at(keys, version)
+            if errors:
+                raise WrongShardServer()
+        dt = now() - t0
+        self._b_read.add(dt)
         return out
 
     async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:  # flowlint: disable=reg-endpoint-span — long-poll: a span over a parked watch would read as minutes of latency
@@ -1042,6 +1262,8 @@ class StorageServer:
         process.register(Tokens.GET_SPLIT_KEY, self.get_split_key)
         process.register(Tokens.WATCH_VALUE, self.watch_value)
         process.register(Tokens.BATCH_GET, self.batch_get)
+        process.register(Tokens.MULTI_GET, self.multi_get)
+        process.register(Tokens.MULTI_GET_RANGE, self.multi_get_range)
         trace(SevInfo, "StorageServerUp", process.address, Tag=self.tag)
 
     def register(self, process) -> None:

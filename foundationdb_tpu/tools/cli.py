@@ -136,6 +136,20 @@ class FdbCli:
                 f"{hz('committed'):.0f} committed/s, "
                 f"{hz('conflicted'):.0f} conflicted/s"
             )
+        ops = wl.get("operations") or {}
+        rb = ops.get("reads_batched") or {}
+        if rb.get("counter"):
+            mb = ops.get("multiget_batches") or {}
+            mrb = ops.get("multiget_range_batches") or {}
+            idx_r = ops.get("index_reads") or {}
+            idx_f = ops.get("index_fallbacks") or {}
+            lines.append(
+                f"Read pipeline: {rb.get('hz') or 0:.0f} batched reads/s "
+                f"({(mb.get('hz') or 0) + (mrb.get('hz') or 0):.0f} batches/s; "
+                f"{rb.get('counter', 0)} total, "
+                f"index {idx_r.get('counter', 0)} / "
+                f"fallback {idx_f.get('counter', 0)})"
+            )
         bands = wl.get("latency_bands") or {}
         for leg in ("grv", "read", "commit"):
             b = bands.get(leg) or {}

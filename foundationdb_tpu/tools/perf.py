@@ -65,6 +65,8 @@ def run_sim(args) -> dict:
     # numbers come from real clusters with ~0.1-0.25 ms hops
     sim.knobs.SIM_FAST_LATENCY = 0.00025
     sim.knobs.SIM_MAX_LATENCY = 0.001
+    if args.no_read_coalescing:
+        sim.knobs.CLIENT_READ_COALESCING = False
     if args.trace_sample > 0:
         # span tracing for stage attribution: a fresh TraceLog so the
         # breakdown covers exactly this run
@@ -132,6 +134,7 @@ def make_workload(args, db, rng, now_fn=None):
             writes_per_txn=w,
             keyspace=args.keyspace,
             now_fn=now_fn,
+            parallel_reads=args.parallel_reads,
         )
     return ReadWriteWorkload(
         db,
@@ -142,6 +145,7 @@ def make_workload(args, db, rng, now_fn=None):
         writes_per_txn=w,
         keyspace=args.keyspace,
         now_fn=now_fn,
+        parallel_reads=args.parallel_reads,
     )
 
 
@@ -155,6 +159,8 @@ def run_tcp_client(args, coordinators) -> dict:
 
     world = RealWorld("127.0.0.1:0")
     world.activate()
+    if args.no_read_coalescing:
+        world.knobs.CLIENT_READ_COALESCING = False  # client-side knob
     db = Database.from_coordinators(world, coordinators.split(","))
     w = make_workload(
         args, db, DeterministicRandom(args.seed), now_fn=time.perf_counter
@@ -207,6 +213,10 @@ def run_tcp(args) -> dict:
                 "--duration", str(args.duration),
                 "--client-procs", str(args.client_procs),
             ]
+            if args.parallel_reads:
+                child_args.append("--parallel-reads")
+            if args.no_read_coalescing:
+                child_args.append("--no-read-coalescing")
             for p in range(args.client_procs):
                 procs.append(
                     subprocess.Popen(
@@ -222,7 +232,22 @@ def run_tcp(args) -> dict:
                 out, _ = p.communicate(timeout=3600)
                 line = [l for l in out.splitlines() if l.startswith("{")][-1]
                 reports.append(json.loads(line))
-            return aggregate(reports)
+            report = aggregate(reports)
+            if args.status_json:
+                # cluster-side evidence next to the client-side rates:
+                # workload counters (reads_batched), latency_probe
+                # percentiles, qos — the sections bench rows cite
+                rc, out = fdbcli(cluster.coord, "status json", timeout=60)
+                if rc == 0:
+                    try:
+                        doc = json.loads(out[out.index("{"):])
+                        report["status"] = {
+                            k: doc.get(k)
+                            for k in ("workload", "latency_probe", "qos")
+                        }
+                    except (ValueError, KeyError):
+                        pass
+            return report
         finally:
             cluster.stop()
 
@@ -266,6 +291,20 @@ def main(argv=None) -> int:
         "--trace-sample", type=float, default=0.0, dest="trace_sample",
         help="> 0: sample this fraction of txns into spans and embed the "
              "read/commit critical-path breakdown in the report (sim mode)",
+    )
+    ap.add_argument(
+        "--parallel-reads", action="store_true", dest="parallel_reads",
+        help="issue each txn's reads concurrently (feeds the read "
+             "coalescer's same-tick multiGet batching)",
+    )
+    ap.add_argument(
+        "--no-read-coalescing", action="store_true", dest="no_read_coalescing",
+        help="force CLIENT_READ_COALESCING off (baseline A/B)",
+    )
+    ap.add_argument(
+        "--status-json", action="store_true", dest="status_json",
+        help="tcp mode: embed the cluster's workload/latency_probe/qos "
+             "status sections in the report",
     )
     ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
     ap.add_argument("--client-id", type=int, default=0, dest="client_id")

@@ -187,6 +187,10 @@ def run_one(
     workloads.append(
         ConsistencyCheckWorkload(db, rng.fork(), replication=cfg.replication)
     )
+    # read-pipeline knobs draw LAST so the pinned seeds' shapes/workload
+    # rotation above reproduce exactly; client knobs are consulted at
+    # read time, so setting them after cluster construction is live
+    knobs.randomize_read_pipeline(shape_rng)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)

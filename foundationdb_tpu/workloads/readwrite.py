@@ -96,6 +96,7 @@ class ReadWriteWorkload(Workload):
         value_len=16,
         prefix=b"rw/",
         now_fn=None,
+        parallel_reads=False,
         **kw,
     ):
         super().__init__(db, rng, **kw)
@@ -106,6 +107,10 @@ class ReadWriteWorkload(Workload):
         self.keyspace = keyspace
         self.value_len = value_len
         self.prefix = prefix
+        # issue each transaction's reads concurrently (the reference's
+        # clients pipeline their gets; with the read coalescer this is
+        # what collapses a txn's N gets into one multiGet hop)
+        self.parallel_reads = parallel_reads
         if now_fn is None:
             from ..runtime.loop import now as now_fn
         self.rec = _Recorder(now_fn)
@@ -134,11 +139,29 @@ class ReadWriteWorkload(Workload):
         for attempt in range(20):
             tr = self.db.transaction()
             try:
-                for _ in range(self.reads_per_txn):
-                    k = self._key(rnd.random_int(0, self.keyspace))
+                if self.parallel_reads and self.reads_per_txn > 1:
+                    keys = [
+                        self._key(rnd.random_int(0, self.keyspace))
+                        for _ in range(self.reads_per_txn)
+                    ]
                     t0 = rec.now()
-                    await tr.get(k)
-                    rec.read_lat.append(rec.now() - t0)
+                    futs = [spawn(tr.get(k)) for k in keys]
+                    try:
+                        await wait_for_all(futs)
+                    except Cancelled:
+                        raise  # actor-cancelled-swallow
+                    except BaseException:
+                        for f in futs:
+                            f.cancel()
+                        raise
+                    dt = rec.now() - t0
+                    rec.read_lat.extend([dt] * len(keys))
+                else:
+                    for _ in range(self.reads_per_txn):
+                        k = self._key(rnd.random_int(0, self.keyspace))
+                        t0 = rec.now()
+                        await tr.get(k)
+                        rec.read_lat.append(rec.now() - t0)
                 for _ in range(self.writes_per_txn):
                     k = self._key(rnd.random_int(0, self.keyspace))
                     tr.set(k, self._value())

@@ -23,8 +23,8 @@ DATA_PREFIX = b"bt/d/"
 RESULT_PREFIX = b"bt/r/"
 
 
-def run_real(seed, n_ops, chaos=False, **cfg):
-    sim = Sim(seed=seed, chaos=chaos)
+def run_real(seed, n_ops, chaos=False, knobs=None, **cfg):
+    sim = Sim(seed=seed, chaos=chaos, knobs=knobs)
     sim.activate()
     cluster = Cluster(sim, ClusterConfig(**cfg))
     db = Database(sim, cluster.proxy_addrs)

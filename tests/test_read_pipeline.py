@@ -1,0 +1,372 @@
+"""Read-pipeline battery (ISSUE 12): same-tick coalescing semantics.
+
+Asserts the four satellite guarantees: (a) same-tick gets collapse into
+exactly one Storage.multiGet hop per storage team, (b) RYW-overlay and
+key-selector results are byte-identical between the batched and
+unbatched paths, (c) the bindingtester oracle stays green with the
+coalescing knob forced both ways, (d) per-entry faults (too_old / drop /
+partial reply) fail only the affected entry's future or degrade it to
+the per-key path without losing correctness — plus the tier-1-safe CPU
+smoke that the batched endpoint actually runs over the range index.
+"""
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.kv.mutations import MutationType
+from foundationdb_tpu.kv.selector import KeySelector
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, settled, spawn, wait_for_all
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.trace import TraceLog, set_trace_log
+from foundationdb_tpu.server import Cluster, ClusterConfig
+from foundationdb_tpu.server.interfaces import (
+    GetKeyServersRequest,
+    MultiGetRequest,
+    READ_ERR_DROPPED,
+    READ_ERR_TOO_OLD,
+)
+
+import pytest
+
+
+def _cluster(seed=3, n_storage=1, replication=1, knobs=None):
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = Cluster(
+        sim, ClusterConfig(n_storage=n_storage, replication=replication)
+    )
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def _span_events(log):
+    return [e for e in log.events if e.get("Type") == "Span"]
+
+
+# -- (a) one batched hop per team ---------------------------------------------
+
+
+def test_same_tick_gets_one_multiget_span_per_team():
+    log = TraceLog()
+    set_trace_log(log)
+    # two single-replica teams: keys below b"\x80" on ss0, above on ss1
+    sim, cluster, db = _cluster(seed=11, n_storage=2)
+    keys = [b"a%02d" % i for i in range(6)] + [b"\x90k%02d" % i for i in range(6)]
+
+    async def go():
+        async def fill(tr):
+            for k in keys:
+                tr.set(k, b"v" + k)
+
+        await db.run(fill)
+        # warm the location cache with an UNSAMPLED transaction so the
+        # measured round's gets all join the same tick (a cache miss
+        # would defer that key's read behind a keyServers hop)
+        warm = db.transaction()
+        for k in keys:
+            assert await warm.get(k) == b"v" + k
+        tr = db.transaction()
+        tr.set_debug_id("txn-read-pipeline")  # forces sampling
+        await tr.get_read_version()
+        futs = [spawn(tr.get(k)) for k in keys]
+        vals = await wait_for_all(futs)
+        assert vals == [b"v" + k for k in keys]
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    spans = _span_events(log)
+    multigets = [s for s in spans if s["Name"] == "Storage.multiGet"]
+    assert len(multigets) == 2, [
+        (s["Name"], s.get("Machine")) for s in multigets
+    ]
+    assert {s.get("Machine") for s in multigets} == {"ss0", "ss1"}
+    # the 12 sampled per-key hops collapsed: 6 keys per team in each batch
+    assert sorted(s.get("keys") for s in multigets) == [6, 6]
+    assert not [s for s in spans if s["Name"] == "Storage.getValue"]
+    set_trace_log(TraceLog())
+
+
+def test_same_tick_ranges_one_multigetrange_span():
+    log = TraceLog()
+    set_trace_log(log)
+    sim, cluster, db = _cluster(seed=13)
+
+    async def go():
+        async def fill(tr):
+            for i in range(40):
+                tr.set(b"r%03d" % i, b"v%d" % i)
+
+        await db.run(fill)
+        warm = db.transaction()
+        await warm.get(b"r000")
+        tr = db.transaction()
+        tr.set_debug_id("txn-range-pipeline")
+        await tr.get_read_version()
+        futs = [
+            spawn(tr.get_range(b"r000", b"r005")),
+            spawn(tr.get_range(b"r010", b"r020", limit=4)),
+            spawn(tr.get_range(b"r020", b"r030", limit=3, reverse=True)),
+        ]
+        a, b, c = await wait_for_all(futs)
+        assert [k for k, _ in a] == [b"r%03d" % i for i in range(5)]
+        assert [k for k, _ in b] == [b"r%03d" % i for i in range(10, 14)]
+        assert [k for k, _ in c] == [b"r%03d" % i for i in (29, 28, 27)]
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    spans = _span_events(log)
+    mgr = [s for s in spans if s["Name"] == "Storage.multiGetRange"]
+    assert len(mgr) == 1 and mgr[0].get("ranges") == 3, mgr
+    assert not [s for s in spans if s["Name"] == "Storage.getRange"]
+    set_trace_log(TraceLog())
+
+
+# -- (b) byte-identical to the unbatched path ---------------------------------
+
+
+def _battery(coalescing: bool):
+    """A scripted RYW + selector + range mix; returns every read result."""
+    knobs = Knobs(CLIENT_READ_COALESCING=coalescing)
+    sim, cluster, db = _cluster(seed=7, n_storage=2, knobs=knobs)
+    out = []
+
+    async def go():
+        async def fill(tr):
+            for i in range(30):
+                tr.set(b"d%03d" % i, b"base%d" % i)
+            for i in range(6):
+                tr.set(b"\x90m%02d" % i, b"hi%d" % i)
+
+        await db.run(fill)
+
+        tr = db.transaction()
+        # RYW overlay: overwrite, atomic chain over a database value,
+        # clear a band, then read it all back through the batched path
+        tr.set(b"d005", b"mine")
+        tr.atomic_op(MutationType.ADD, b"d007", (3).to_bytes(8, "little"))
+        tr.clear_range(b"d010", b"d013")
+        futs = [spawn(tr.get(b"d%03d" % i)) for i in range(16)]
+        out.append(await wait_for_all(futs))
+        # selector resolutions (merged-overlay and storage walks)
+        sels = [
+            KeySelector.first_greater_or_equal(b"d006"),
+            KeySelector.last_less_than(b"d010"),
+            KeySelector.first_greater_than(b"d029"),
+            KeySelector.first_greater_or_equal(b"d000" + b"\x00"),
+        ]
+        out.append(
+            await wait_for_all([spawn(tr.get_key(s)) for s in sels])
+        )
+        # ranges: forward, limited, reverse, cross-team, selector-ended
+        rfuts = [
+            spawn(tr.get_range(b"d000", b"d020", limit=7)),
+            spawn(tr.get_range(b"d004", b"d016")),
+            spawn(tr.get_range(b"d000", b"d030", limit=5, reverse=True)),
+            spawn(tr.get_range(b"a", b"\xff")),
+            spawn(
+                tr.get_range(
+                    KeySelector.first_greater_than(b"d002"), b"d009"
+                )
+            ),
+        ]
+        out.append(await wait_for_all(rfuts))
+        await tr.commit()
+
+        # a second transaction sees the committed state
+        tr2 = db.transaction()
+        out.append(await wait_for_all(
+            [spawn(tr2.get(b"d%03d" % i)) for i in (5, 7, 11)]
+        ))
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    return out
+
+
+def test_coalesced_results_byte_identical_to_unbatched():
+    assert _battery(True) == _battery(False)
+
+
+# -- (c) bindingtester oracle with the knob both ways -------------------------
+
+
+@pytest.mark.parametrize("coalescing", [True, False])
+def test_bindingtester_oracle_with_coalescing_knob(coalescing):
+    from test_bindingtester import run_model, run_real
+
+    stream, (data_real, log_real) = run_real(
+        seed=31, n_ops=400,
+        knobs=Knobs(CLIENT_READ_COALESCING=coalescing),
+    )
+    data_model, log_model = run_model(stream)
+    assert list(data_real) == list(data_model)
+    assert list(log_real) == list(log_model)
+
+
+# -- (d) per-entry faults ------------------------------------------------------
+
+
+def test_too_old_subset_fails_only_that_future():
+    sim, cluster, db = _cluster(seed=17)
+    ss = cluster.storages[0]
+    poison = b"f/poison"
+
+    def inj(req, reply):
+        if isinstance(req, MultiGetRequest):
+            for i, k in enumerate(req.keys):
+                if k == poison:
+                    reply.errors = list(reply.errors) + [(i, READ_ERR_TOO_OLD)]
+        return reply
+
+    ss._read_fault_injector = inj
+
+    async def go():
+        async def fill(tr):
+            for k in (b"f/a", poison, b"f/z"):
+                tr.set(k, b"v" + k)
+
+        await db.run(fill)
+        warm = db.transaction()
+        await warm.get(b"f/a")
+        tr = db.transaction()
+        await tr.get_read_version()
+        futs = [spawn(tr.get(k)) for k in (b"f/a", poison, b"f/z")]
+        for f in futs:
+            await settled(f)
+        from foundationdb_tpu.errors import TransactionTooOld
+
+        assert futs[0].get() == b"vf/a"
+        assert futs[2].get() == b"vf/z"
+        assert futs[1].is_error()
+        try:
+            futs[1].get()
+        except TransactionTooOld:
+            pass
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+
+
+def test_dropped_and_partial_replies_degrade_to_per_key_reads():
+    sim, cluster, db = _cluster(seed=19)
+    ss = cluster.storages[0]
+
+    def inj(req, reply):
+        if isinstance(req, MultiGetRequest) and len(req.keys) >= 2:
+            # partial reply: the tail entry vanishes entirely, another is
+            # marked dropped — the client must re-read both per-key
+            reply.values = list(reply.values[:-1])
+            reply.errors = list(reply.errors) + [(0, READ_ERR_DROPPED)]
+        return reply
+
+    ss._read_fault_injector = inj
+    keys = [b"p/%02d" % i for i in range(8)]
+
+    async def go():
+        async def fill(tr):
+            for k in keys:
+                tr.set(k, b"v" + k)
+
+        await db.run(fill)
+        warm = db.transaction()
+        await warm.get(keys[0])
+        tr = db.transaction()
+        await tr.get_read_version()
+        vals = await wait_for_all([spawn(tr.get(k)) for k in keys])
+        assert vals == [b"v" + k for k in keys]
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    assert ss.stats.counters["multiGetBatches"].value >= 1
+
+
+def test_pipeline_depth_and_chunking_drain_queued_batches():
+    knobs = Knobs(
+        CLIENT_MULTIGET_MAX_KEYS=2, CLIENT_READ_PIPELINE_DEPTH=1
+    )
+    sim, cluster, db = _cluster(seed=23, knobs=knobs)
+    ss = cluster.storages[0]
+    keys = [b"q/%02d" % i for i in range(9)]
+
+    async def go():
+        async def fill(tr):
+            for k in keys:
+                tr.set(k, b"v" + k)
+
+        await db.run(fill)
+        warm = db.transaction()
+        await warm.get(keys[0])
+        tr = db.transaction()
+        await tr.get_read_version()
+        vals = await wait_for_all([spawn(tr.get(k)) for k in keys])
+        assert vals == [b"v" + k for k in keys]
+        return True
+
+    assert sim.run_until_done(spawn(go()), 300.0)
+    # 9 same-tick keys at max 2 per batch = 5 chunks, drained through the
+    # depth-1 pipeline one at a time
+    assert ss.stats.counters["multiGetBatches"].value >= 5
+
+
+# -- tier-1-safe CPU smoke: the index answers the batch -----------------------
+
+
+def test_batched_path_exercised_over_range_index_cpu():
+    from foundationdb_tpu.net.sim import Endpoint
+    from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+    from foundationdb_tpu.server.interfaces import Tokens
+
+    knobs = Knobs(
+        STORAGE_TPU_INDEX=True,
+        MAX_READ_TRANSACTION_LIFE_VERSIONS=1_000_000,  # fast durability
+    )
+    sim = Sim(seed=71, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(sim, ClusterConfig(n_storage=1, n_tlogs=1))
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    keys = [b"ix/%03d" % i for i in range(32)]
+
+    async def go():
+        async def fill(tr):
+            for k in keys:
+                tr.set(k, b"v" + k)
+
+        await db.run(fill)
+        # let the durability loop drop the rows to the engine and build
+        # the range-index snapshot, so the batch MUST miss the window
+        await delay(8.0)
+        warm = db.transaction()
+        await warm.get(keys[0])
+        tr = db.transaction()
+        await tr.get_read_version()
+        vals = await wait_for_all([spawn(tr.get(k)) for k in keys])
+        assert vals == [b"v" + k for k in keys]
+        # legacy batchGet rides the same shared core (parity)
+        version = await tr.get_read_version()
+        reply = await db._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=keys[0])
+        )
+        bg = await db.client.request(
+            Endpoint(reply.team[0], Tokens.BATCH_GET), (keys, version)
+        )
+        assert bg == vals
+        return True
+
+    assert sim.run_until_done(spawn(go()), 600.0)
+    # the batch's engine misses went through TpuRangeIndex.batch_lookup
+    snaps = []
+    for addr, proc in sim.processes.items():
+        for token, handler in proc.endpoints.items():
+            if token.startswith("storage.metrics#"):
+                snaps.append((addr, handler))
+
+    async def pull():
+        out = []
+        for _addr, h in snaps:
+            out.append(await h(None))
+        return out
+
+    metrics = sim.run_until_done(spawn(pull()), 60.0)
+    total_keys = sum(m.get("multiGetKeys", 0) for m in metrics)
+    total_index = sum(m.get("multiGetIndexKeys", 0) for m in metrics)
+    assert total_keys >= len(keys)
+    assert total_index >= len(keys) - 1, (total_keys, total_index)

@@ -79,7 +79,11 @@ def test_sim_propagation_parent_child_across_three_hops():
 
     resolver_leaves = [s for s in spans if s["Name"] == "Resolver.resolve"]
     tlog_leaves = [s for s in spans if s["Name"] == "TLog.push"]
-    storage_leaves = [s for s in spans if s["Name"] == "Storage.getValue"]
+    # reads ride the batched pipeline by default (ISSUE 12): the storage
+    # leaf of a sampled get is the multiGet hop
+    storage_leaves = [
+        s for s in spans if s["Name"] in ("Storage.multiGet", "Storage.getValue")
+    ]
     assert resolver_leaves and tlog_leaves and storage_leaves
     for leaves in (resolver_leaves, tlog_leaves):
         assert any(
@@ -282,9 +286,14 @@ def test_read_waterfall_covers_p50(request):
     assert agg["p50_ms"] > 0
     # named stages account for ≥90% of the measured read latency
     assert agg["coverage"] >= 0.9, agg
-    # the stage names an operator needs are all attributed
+    # the stage names an operator needs are all attributed; with read
+    # coalescing on (the default) the per-key Client.rpc/Storage.* stages
+    # collapse into the batched multiGet hop
     stage_names = {s["stage"] for s in agg["stages"]}
-    assert {"Client.rpc", "Storage.getValue"} <= stage_names, stage_names
+    assert {"Client.rpc", "Client.multiGet", "Storage.multiGet"} <= stage_names, (
+        stage_names
+    )
+    assert "Storage.getValue" not in stage_names, stage_names
     # and a waterfall renders for some sampled read
     traces = ta.spans_by_trace(log.events)
     read_traces = [
