@@ -210,6 +210,70 @@ def bench_read_pipeline():
     }))
 
 
+def bench_admission():
+    """BENCH_COMPONENT=admission: the overload A/B (ISSUE 13). Two legs of
+    tools/perf --overload-factor (same seed, same offered load): admission
+    ON (per-class buckets + deadline shedding) vs OFF (the pre-ISSUE-13
+    unbounded deadline-free park). Evidence embedded per leg: goodput vs
+    calibrated peak, admitted-traffic commit p95, and the cluster's
+    qos/workload/latency_probe status sections. Writes BENCH_r08.json."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    factor = os.environ.get("BENCH_OVERLOAD_FACTOR", "5")
+    actors = os.environ.get("BENCH_OVERLOAD_ACTORS", "20")
+    duration = os.environ.get("BENCH_OVERLOAD_DURATION", "3.0")
+
+    def run_perf(extra):
+        cmd = [
+            sys.executable, "-m", "foundationdb_tpu.tools.perf",
+            "--overload-factor", factor, "--actors", actors,
+            "--duration", duration,
+        ] + extra
+        log("running: " + " ".join(cmd[3:]))
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=3600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+        for ln in (r.stderr or "").strip().splitlines()[-4:]:
+            log("perf| " + ln)
+        lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+        return json.loads(lines[-1]) if lines else None
+
+    on = run_perf([])
+    off = run_perf(["--no-admission"])
+    goodput_on = (on or {}).get("goodput_ratio", 0.0)
+    goodput_off = (off or {}).get("goodput_ratio", 0.0)
+    p95_on = (on or {}).get("admitted_commit_p95_ms", 0.0)
+    p95_off = (off or {}).get("admitted_commit_p95_ms", 0.0)
+    artifact = {
+        "metric": "admission_overload_goodput_ratio",
+        "value": goodput_on,
+        "unit": "goodput/peak at ~%sx offered load" % factor,
+        "vs_baseline": round(goodput_on / max(goodput_off, 1e-9), 2),
+        "admitted_commit_p95_ms_on": p95_on,
+        "admitted_commit_p95_ms_off": p95_off,
+        "shape": f"overload x{factor}, {actors} base actors, {duration}s legs",
+        "admission_on": on,
+        "admission_off": off,
+    }
+    with open(os.path.join(repo, "BENCH_r08.json"), "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    log(
+        f"admission overload A/B: goodput ON {goodput_on:.2f} of peak "
+        f"(p95 {p95_on:.1f} ms) vs OFF {goodput_off:.2f} (p95 "
+        f"{p95_off:.1f} ms)"
+    )
+    print(json.dumps({
+        k: artifact[k]
+        for k in (
+            "metric", "value", "unit", "vs_baseline",
+            "admitted_commit_p95_ms_on", "admitted_commit_p95_ms_off",
+            "shape",
+        )
+    }))
+
+
 def bench_e2e():
     """BENCH_COMPONENT=e2e: whole-system commit throughput + latency — N
     clients through client→proxy→resolver→tlog→storage in simulation
@@ -645,6 +709,9 @@ def main():
         return
     if os.environ.get("BENCH_COMPONENT") == "read_pipeline":
         bench_read_pipeline()
+        return
+    if os.environ.get("BENCH_COMPONENT") == "admission":
+        bench_admission()
         return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 

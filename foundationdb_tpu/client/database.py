@@ -72,8 +72,17 @@ class Database:
         self._proxies: AsyncVar = AsyncVar(proxy_ifaces)
         # location cache: key range → team addresses (None = unknown)
         self._locations = KeyRangeMap(default=None)
-        # GRV batcher (readVersionBatcher, NativeAPI.actor.cpp:1290)
-        self._grv_batcher = RequestBatcher(self._fetch_grv, self.client.spawn)
+        # GRV batchers (readVersionBatcher, NativeAPI.actor.cpp:1290), one
+        # per (priority class, tenant): the envelope now carries admission
+        # fields (ISSUE 13), and batching across classes would let batch
+        # traffic ride immediate-class grants
+        self._grv_batchers: dict[tuple, RequestBatcher] = {}
+        # database-level admission defaults (server/admission.py classes);
+        # transactions inherit them and may override per-txn
+        from ..server.admission import PRIORITY_DEFAULT
+
+        self.default_priority = PRIORITY_DEFAULT
+        self.default_tenant = ""
         # same-tick read coalescing into storage multiGet batches
         # (client/read_coalescer.py; CLIENT_READ_COALESCING gates use)
         from .read_coalescer import ReadCoalescer
@@ -157,17 +166,39 @@ class Database:
                 )
         raise last_err
 
-    async def get_read_version(self) -> int:
+    async def get_read_version(self, priority=None, tenant=None) -> int:
         """Batched GRV (the reference's readVersionBatcher,
         NativeAPI.actor.cpp:1290): concurrent callers coalesce into one
         proxy round trip — an idle client pays no added latency, a busy
-        one amortizes the RPC."""
-        return await self._grv_batcher.join()
+        one amortizes the RPC. Callers batch per (priority, tenant) so a
+        shared fetch never crosses admission classes; a throttled fetch
+        (grv_throttled) errors every joined caller, and each one backs
+        off through Transaction.on_error (bounded)."""
+        from ..server.admission import coerce_priority
 
-    async def _fetch_grv(self) -> int:
+        priority = coerce_priority(
+            self.default_priority if priority is None else priority
+        )
+        tenant = self.default_tenant if tenant is None else tenant
+        key = (priority, tenant)
+        b = self._grv_batchers.get(key)
+        if b is None:
+            b = self._grv_batchers[key] = RequestBatcher(
+                lambda n, p=priority, t=tenant: self._fetch_grv(p, t, n),
+                self.client.spawn,
+                counted=True,  # admission debits per transaction
+            )
+        return await b.join()
+
+    async def _fetch_grv(self, priority, tenant, count: int = 1) -> int:
         if buggify():
             await delay(0.001)  # GRV straggler (batcher forms bigger batches)
-        reply = await self._proxy_request(Tokens.GRV, GetReadVersionRequest())
+        reply = await self._proxy_request(
+            Tokens.GRV,
+            GetReadVersionRequest(
+                priority=priority, tenant=tenant, count=count
+            ),
+        )
         return reply.version
 
     async def _locate(self, key: bytes):
@@ -282,8 +313,13 @@ class Database:
 
     # -- transactions ----------------------------------------------------------
 
-    def transaction(self) -> Transaction:
-        return Transaction(self)
+    def transaction(self, priority=None, tenant=None) -> Transaction:
+        tr = Transaction(self)
+        if priority is not None:
+            tr.set_priority(priority)
+        if tenant is not None:
+            tr.set_tenant(tenant)
+        return tr
 
     async def run(self, body, max_retries: Optional[int] = None):
         """Run ``await body(tr)`` then commit, retrying on retryable errors —

@@ -84,9 +84,29 @@ class Transaction:
         self.debug_id: str = ""
         self._span_root = None  # SpanContext once sampled
         self._trace_decided = False
+        # admission options (ISSUE 13): priority class + tenant id ride
+        # the GRV envelope (server/admission.py). Inherited from the
+        # database's defaults; survive reset() (a retry keeps its class —
+        # the reference's onError preserves option state the same way)
+        self.priority = db.default_priority
+        self.tenant: str = db.default_tenant
 
     def set_debug_id(self, debug_id: str) -> None:
         self.debug_id = debug_id
+
+    # -- admission options (fdb_transaction_set_option PRIORITY_* / tenant) ----
+
+    def set_priority(self, priority) -> None:
+        """Transaction priority class: "batch" / "default" / "immediate"
+        (or the admission module's int constants). Batch sheds first
+        under overload; immediate is for system/probe traffic."""
+        from ..server.admission import coerce_priority
+
+        self.priority = coerce_priority(priority)
+
+    def set_tenant(self, tenant: str) -> None:
+        """Tenant id for per-tenant admission fair-share ("" = none)."""
+        self.tenant = tenant or ""
 
     # -- distributed-trace sampling (TRACE_SAMPLE_RATE / debug ids) ------------
 
@@ -132,11 +152,15 @@ class Transaction:
             sp = self._op_span("Client.getReadVersion")
             if sp is None:
                 # batched through the database's readVersionBatcher
-                self._read_version = await self.db.get_read_version()
+                self._read_version = await self.db.get_read_version(
+                    self.priority, self.tenant
+                )
             else:
                 with sp:
                     sp.event("ClientGRVStart", kind="ReadDebug")
-                    self._read_version = await self.db.get_read_version()
+                    self._read_version = await self.db.get_read_version(
+                        self.priority, self.tenant
+                    )
                     sp.event("ClientGRVDone", kind="ReadDebug")
         return self._read_version
 
@@ -610,8 +634,12 @@ class Transaction:
 
     def reset(self) -> None:
         backoff = getattr(self, "_backoff", 0.0)
+        priority, tenant = self.priority, self.tenant
         self.__init__(self.db)
         self._backoff = backoff
+        # admission options survive reset: a throttled-then-retried txn
+        # must not silently jump admission class
+        self.priority, self.tenant = priority, tenant
 
     async def on_error(self, e: Exception) -> None:
         """Backoff + reset for retryable errors (Transaction::onError,

@@ -30,6 +30,17 @@ class FutureVersion(FdbError):
     retryable = True
 
 
+class GrvThrottled(FdbError):
+    """GRV shed by proxy admission control (the analog of error 1911
+    proxy_memory_limit_exceeded / the GRV throttle): the cluster is over
+    capacity for this transaction's priority class (or this tenant's
+    share) and the request was rejected at admission rather than queued
+    into collapse. Retryable — clients back off (bounded; see
+    Transaction.on_error) and resubmit."""
+
+    retryable = True
+
+
 class CommitUnknownResult(FdbError):
     """Connection to proxy lost mid-commit; txn may or may not have
     committed (error 1021). Retryable, but retries must be idempotent."""

@@ -405,11 +405,16 @@ class RequestBatcher:
     fetch began could be newer), so only pre-flight arrivals share.
 
     ``fetch`` is a zero-arg coroutine function; ``spawn`` schedules the
-    batcher actor (e.g. ``process.spawn`` or a client's spawn)."""
+    batcher actor (e.g. ``process.spawn`` or a client's spawn).
+    ``counted=True`` calls ``fetch(n)`` with the batch size instead — the
+    GRV batcher reports how many transactions share the fetch so proxy
+    admission debits per TRANSACTION, not per coalesced request (the
+    reference's GetReadVersionRequest.transactionCount)."""
 
-    def __init__(self, fetch, spawn_fn):
+    def __init__(self, fetch, spawn_fn, counted: bool = False):
         self._fetch = fetch
         self._spawn = spawn_fn
+        self._counted = counted
         self._waiters: list[Future] = []
         self._running = False
 
@@ -426,7 +431,11 @@ class RequestBatcher:
             while self._waiters:
                 waiters, self._waiters = self._waiters, []
                 try:
-                    value = await self._fetch()
+                    value = await (
+                        self._fetch(len(waiters))
+                        if self._counted
+                        else self._fetch()
+                    )
                 except Cancelled:
                     # actor-cancelled-swallow: the batcher dies with its
                     # cancellation, but parked callers must not hang on a

@@ -88,11 +88,53 @@ class Knobs:
     RESOLUTION_BALANCE_MIN_OPS = 200  # min per-interval imbalance to act
     RESOLUTION_BALANCE_RATIO = 1.5  # max/min load ratio that triggers a move
     RESOLUTION_SAMPLE_KEYS = 4096  # per-resolver load sample cap
-    # ratekeeper (admission control by worst storage version lag)
+    # ratekeeper (multi-signal admission control, ISSUE 13): per-class
+    # rates from storage lag + tlog queue depth + run-loop busy fraction
+    # + latency-band overrun + conflict-kernel health
     RK_POLL_INTERVAL = 0.5  # proxy -> master getRate cadence
     RK_MAX_TPS = 100_000.0
     RK_LAG_TARGET = 2_000_000  # start throttling here (versions)
     RK_LAG_MAX = 4_000_000  # floor rate here (MVCC window is 5M)
+    # master does NOT gate admission entirely off: the floor keeps the
+    # cluster draining (progress is what shrinks every signal)
+    RK_RATE_FLOOR = 0.05  # default-class floor as a fraction of RK_MAX_TPS
+    # worst storage durable-version lag (version - durableVersion): the
+    # write-queue signal (limitReason storage_server_write_queue_size).
+    # Calibrated ABOVE the sim's healthy steady state (~4.5-5.5M versions:
+    # versions advance at 1M/s and durability batches seconds behind) so
+    # only growth beyond baseline throttles
+    RK_DURABILITY_LAG_TARGET = 6_000_000
+    RK_DURABILITY_LAG_MAX = 12_000_000
+    # worst tlog DiskQueue backlog (bytes not yet popped by consumers)
+    RK_TLOG_QUEUE_TARGET = 2 << 20
+    RK_TLOG_QUEUE_MAX = 8 << 20
+    # run-loop busy fraction (PR 9's profiler gauge; REAL personality
+    # only — a sim loop is busy by construction)
+    RK_BUSY_FRACTION_TARGET = 0.90
+    RK_BUSY_FRACTION_MAX = 0.98
+    # latency-band overrun: fraction of proxy GRV/commit requests in the
+    # poll interval that landed above RK_BAND_SLO seconds
+    RK_BAND_SLO = 0.5
+    RK_BAND_OVERRUN_TARGET = 0.05
+    RK_BAND_OVERRUN_MAX = 0.25
+    # conflict-kernel health (kernel.health): a DEGRADED kernel tightens
+    # admission instead of queueing resolve batches into the dispatch
+    # deadline; FAILED_OVER runs on the (slower) native backend
+    RK_KERNEL_DEGRADED_FACTOR = 0.5
+    RK_KERNEL_FAILED_OVER_FACTOR = 0.75
+    # batch class throttles FIRST: its thresholds sit at this fraction of
+    # the default class's targets (shed-order batch -> default -> immediate)
+    RK_BATCH_SENSITIVITY = 0.5
+    RK_RATE_SMOOTHING = 0.5  # exponential smoothing of per-class rates
+    # proxy admission queue (server/admission.py): bounded depth per
+    # class; waiters past their deadline shed with grv_throttled
+    RK_GRV_QUEUE_MAX = 512  # per class per proxy
+    RK_GRV_QUEUE_TIMEOUT = 0.5  # default-class queue deadline (s);
+    #                             batch waits 0.5x, immediate 2x
+    RK_ADMISSION_TICK = 0.02  # pump cadence while waiters are parked (s)
+    RK_TENANT_MAX_SHARE = 0.5  # one tenant's cap as a fraction of the
+    #                            default-class per-proxy rate
+    RK_STATUS_TENANTS = 8  # per-tenant top-N surfaced through status
     # observability
     # run-loop profiler (runtime/profiler.py): per-actor busy attribution,
     # per-priority starvation, SlowTask events (the reference's run-loop
@@ -247,6 +289,25 @@ class Knobs:
             self.GETCOMMITVERSION_TIMEOUT,
             self.MASTER_VERSION_GAP_TIMEOUT + 2.0,
         )
+
+    def randomize_admission(self, rng) -> None:
+        """Admission-control knob randomization (ISSUE 13), kept OUT of
+        randomize() for the same pinned-seed reason as the read-pipeline
+        knobs: the soak draws these at the very END of its sequence so
+        every pinned chaos seed's cluster shape and workload rotation
+        reproduce exactly. Capacity (RK_MAX_TPS) already randomizes in
+        randomize(); these shape the queue/shed/tenant behavior only."""
+        if rng.coinflip(0.25):
+            # tiny queues force the shed-on-arrival path
+            self.RK_GRV_QUEUE_MAX = rng.random_choice([8, 64, 512])
+        if rng.coinflip(0.25):
+            self.RK_GRV_QUEUE_TIMEOUT = rng.random_choice([0.1, 0.5, 2.0])
+        if rng.coinflip(0.25):
+            self.RK_TENANT_MAX_SHARE = rng.random_choice([0.25, 0.5, 1.0])
+        if rng.coinflip(0.25):
+            self.RK_BATCH_SENSITIVITY = rng.random_choice([0.25, 0.5, 0.75])
+        if rng.coinflip(0.25):
+            self.RK_ADMISSION_TICK = rng.random_choice([0.005, 0.02, 0.05])
 
     def randomize_read_pipeline(self, rng) -> None:
         """Read-pipeline knob randomization, kept OUT of randomize():

@@ -22,7 +22,7 @@ import struct
 #        (distributed tracing; net/tcp.py "req" messages)
 # gen 5: batched read pipeline — storage.multiGet / storage.multiGetRange
 #        endpoints and their MultiGet*Request/Reply shapes (ISSUE 12)
-PROTOCOL_VERSION = 0x0FDB00B070010005
+PROTOCOL_VERSION = 0x0FDB00B070010006  # gen-6: GRV priority/tenant envelope
 
 
 class BinaryWriter:

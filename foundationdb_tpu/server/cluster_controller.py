@@ -335,10 +335,20 @@ class ClusterController:
             budget = max(self.knobs.LATENCY_PROBE_INTERVAL, 1.0)
             latest = {}
             try:
-                # GRV probe (the reference's transaction_start_seconds)
+                # GRV probe (the reference's transaction_start_seconds) at
+                # IMMEDIATE priority: the probe is the evidence source for
+                # overload behavior, so it must keep measuring while lower
+                # classes are being shed (not be shed itself)
+                from .admission import PRIORITY_IMMEDIATE
+
                 t0 = now()
                 grv = await timeout(
-                    self.process.request(proxy.ep("grv"), GetReadVersionRequest()),
+                    self.process.request(
+                        proxy.ep("grv"),
+                        GetReadVersionRequest(
+                            priority=PRIORITY_IMMEDIATE, tenant=""
+                        ),
+                    ),
                     budget,
                 )
                 if grv is None:
@@ -632,7 +642,41 @@ class ClusterController:
             "transactions_committed_total": txn_out,
             "conflicts_total": conflicts,
             "storage_finished_queries_total": ops,
+            # admission control (ISSUE 13): total GRVs shed with
+            # grv_throttled, plus the per-class admitted traffic
+            "throttled_total": agg("proxy", "grvThrottled"),
+            "throttled_per_class": {
+                c: agg("proxy", "grvThrottled" + c.capitalize())
+                for c in ("batch", "default", "immediate")
+            },
+            "admitted_per_class": {
+                c: {
+                    "counter": agg("proxy", "txnStart" + c.capitalize()),
+                    "hz": round(
+                        agg("proxy", "txnStart" + c.capitalize() + "_hz"), 2
+                    ),
+                }
+                for c in ("batch", "default", "immediate")
+            },
         }
+        # per-tenant admission roll-up (top-N by traffic across proxies)
+        tenants: dict = {}
+        for w in workers.values():
+            for snap in (w.get("metrics") or {}).values():
+                if snap.get("kind") != "proxy":
+                    continue
+                for tenant, s in (snap.get("tenants") or {}).items():
+                    agg_t = tenants.setdefault(
+                        tenant, {"admitted": 0, "throttled": 0}
+                    )
+                    agg_t["admitted"] += s.get("admitted") or 0
+                    agg_t["throttled"] += s.get("throttled") or 0
+        if tenants:
+            top = sorted(
+                tenants.items(),
+                key=lambda kv: -(kv[1]["admitted"] + kv[1]["throttled"]),
+            )[: self.knobs.RK_STATUS_TENANTS]
+            doc["qos"]["tenants"] = dict(top)
         if committed:
             worst_lag = max(v - d for v, d in zip(committed, durable))
             doc["qos"]["worst_storage_durability_lag_versions"] = worst_lag
@@ -641,7 +685,9 @@ class ClusterController:
                 if worst_lag > self.knobs.RK_LAG_TARGET
                 else "workload"
             )
-        # ratekeeper's released rate (master.getRate#uid on the master)
+        # ratekeeper's released per-class rates (master.getRate#uid); its
+        # limiting factor (the multi-signal controller's) wins over the
+        # local lag heuristic above
         if info is not None and info.master_address:
             try:
                 rate = await timeout(
@@ -654,7 +700,17 @@ class ClusterController:
                     ),
                     1.0,
                 )
-                if rate is not None:
+                if isinstance(rate, dict):
+                    doc["qos"]["released_transactions_per_second"] = rate.get(
+                        "released"
+                    )
+                    doc["qos"]["released_per_class"] = {
+                        k: round(v, 2)
+                        for k, v in (rate.get("cluster") or {}).items()
+                    }
+                    if rate.get("limiting"):
+                        doc["qos"]["limiting"] = rate["limiting"]
+                elif rate is not None:
                     doc["qos"]["released_transactions_per_second"] = rate
             except Cancelled:
                 raise  # actor-cancelled-swallow

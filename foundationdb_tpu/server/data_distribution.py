@@ -365,43 +365,368 @@ class DataDistributor:
                 load[t] += 1
 
 
-class Ratekeeper:
-    """Version-lag-driven admission control (updateRate, simplified)."""
+def _drain_factor(x, lo, hi) -> float:
+    """1.0 while the signal is under its target, falling linearly to 0.0
+    at its maximum (the reference updateRate's limit smoothing shape)."""
+    if x is None or x <= lo:
+        return 1.0
+    if x >= hi:
+        return 0.0
+    return 1.0 - (x - lo) / (hi - lo)
 
-    def __init__(self, process, master, storage, knobs, uid: str):
+
+def compute_rates(knobs, sig: dict) -> tuple[dict, str]:
+    """Pure multi-signal controller (updateRate, Ratekeeper.actor.cpp):
+    signals → cluster-wide per-class target rates + the limiting reason.
+
+    Signals (any may be None = unknown, treated as healthy):
+      version_lag      worst (last assigned − storage version)
+      durability_lag   worst (storage version − durable version)
+      tlog_queue_bytes worst tlog DiskQueue backlog
+      busy_fraction    worst run-loop busy fraction (real loops only)
+      band_overrun     fraction of proxy GRV/commit requests above
+                       RK_BAND_SLO in the last interval
+      kernel_state     worst conflict-kernel health state
+
+    Classes drain in shed order: batch thresholds sit at
+    RK_BATCH_SENSITIVITY of default's (batch rate may reach 0 — full
+    shed); default is floored at RK_RATE_FLOOR; immediate throttles only
+    when the MVCC window itself is threatened (or the kernel is FAILED)."""
+    kernel_factor = {
+        "DEGRADED": knobs.RK_KERNEL_DEGRADED_FACTOR,
+        "FAILED_OVER": knobs.RK_KERNEL_FAILED_OVER_FACTOR,
+        "FAILED": 0.1,
+    }.get(sig.get("kernel_state"), 1.0)
+    factors = {
+        "storage_version_lag": _drain_factor(
+            sig.get("version_lag"), knobs.RK_LAG_TARGET, knobs.RK_LAG_MAX
+        ),
+        "storage_durability_lag": _drain_factor(
+            sig.get("durability_lag"),
+            knobs.RK_DURABILITY_LAG_TARGET,
+            knobs.RK_DURABILITY_LAG_MAX,
+        ),
+        "tlog_queue": _drain_factor(
+            sig.get("tlog_queue_bytes"),
+            knobs.RK_TLOG_QUEUE_TARGET,
+            knobs.RK_TLOG_QUEUE_MAX,
+        ),
+        "run_loop_busy": _drain_factor(
+            sig.get("busy_fraction"),
+            knobs.RK_BUSY_FRACTION_TARGET,
+            knobs.RK_BUSY_FRACTION_MAX,
+        ),
+        "latency_bands": _drain_factor(
+            sig.get("band_overrun"),
+            knobs.RK_BAND_OVERRUN_TARGET,
+            knobs.RK_BAND_OVERRUN_MAX,
+        ),
+        "kernel_degraded": kernel_factor,
+    }
+    limiting = min(factors, key=factors.get)
+    f_default = factors[limiting]
+    if f_default >= 1.0:
+        limiting = "workload"
+    # batch: same signals through tighter thresholds (scale lo toward 0,
+    # keep hi) so batch sheds first and fully (no floor)
+    s = knobs.RK_BATCH_SENSITIVITY
+    f_batch = min(
+        _drain_factor(
+            sig.get("version_lag"), knobs.RK_LAG_TARGET * s, knobs.RK_LAG_MAX
+        ),
+        _drain_factor(
+            sig.get("durability_lag"),
+            knobs.RK_DURABILITY_LAG_TARGET * s,
+            knobs.RK_DURABILITY_LAG_MAX,
+        ),
+        _drain_factor(
+            sig.get("tlog_queue_bytes"),
+            knobs.RK_TLOG_QUEUE_TARGET * s,
+            knobs.RK_TLOG_QUEUE_MAX,
+        ),
+        _drain_factor(
+            sig.get("busy_fraction"),
+            knobs.RK_BUSY_FRACTION_TARGET * s,
+            knobs.RK_BUSY_FRACTION_MAX,
+        ),
+        _drain_factor(
+            sig.get("band_overrun"),
+            knobs.RK_BAND_OVERRUN_TARGET * s,
+            knobs.RK_BAND_OVERRUN_MAX,
+        ),
+        kernel_factor * kernel_factor,  # kernel trouble bites batch twice
+    )
+    # immediate: only MVCC-window danger or a fully FAILED kernel
+    f_immediate = _drain_factor(
+        sig.get("version_lag"),
+        knobs.RK_LAG_MAX,
+        knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS,
+    )
+    if sig.get("kernel_state") == "FAILED":
+        f_immediate = min(f_immediate, 0.5)
+    floor = knobs.RK_MAX_TPS * knobs.RK_RATE_FLOOR
+    rates = {
+        "batch": knobs.RK_MAX_TPS * f_batch,
+        "default": max(knobs.RK_MAX_TPS * f_default, floor),
+        "immediate": max(knobs.RK_MAX_TPS * f_immediate, floor),
+    }
+    return rates, limiting
+
+
+class Ratekeeper:
+    """Multi-signal admission controller (updateRate, Ratekeeper.actor.cpp,
+    grown from the single-signal lag controller): emits per-priority-class
+    rates consumed by the proxies' admission queues (server/admission.py).
+
+    Membership is LIVE: each control interval polls the cluster
+    controller's worker registry and reads every hosted role's metrics
+    (worker.metrics), so storage servers recruited after this Ratekeeper
+    booted are visible to lag monitoring — the construction-time snapshot
+    is only the fallback for the window before the registry answers."""
+
+    def __init__(
+        self,
+        process,
+        master,
+        storage,
+        knobs,
+        uid: str,
+        cc_address: str = "",
+        n_proxies: int = 1,
+    ):
+        from ..runtime.stats import CounterCollection
+
         self.process = process
         self.master = master  # the Master (version authority) instance
-        self.storage = list(storage)
+        self.storage = list(storage)  # seed interfaces (registry fallback)
         self.knobs = knobs
-        self.rate = float(self.knobs.RK_MAX_TPS)
+        self.uid = uid
+        self.cc_address = cc_address
+        self.n_proxies = max(int(n_proxies), 1)
+        full = float(self.knobs.RK_MAX_TPS)
+        self.rates = {"batch": full, "default": full, "immediate": full}
+        self.limiting = "workload"
+        self.signals: dict = {}
+        # per-proxy cumulative above-SLO band totals (overrun is an
+        # interval rate, bands are lifetime-exact)
+        self._band_last: dict[str, tuple] = {}
+        # RatekeeperMetrics: its own CounterCollection + metrics endpoint
+        # (the new-role-surface rule, ROADMAP standing guidance)
+        self.stats = CounterCollection("Ratekeeper", uid)
+        self._c_loops = self.stats.counter("controlLoops")
+        self._c_registry = self.stats.counter("membershipPolls")
+        self._c_registry_err = self.stats.counter("membershipErrors")
+        self._c_fallback = self.stats.counter("seedFallbackPolls")
+        self.stats.gauge("rates", lambda: {
+            k: round(v, 2) for k, v in self.rates.items()
+        })
+        self.stats.gauge("limiting", lambda: self.limiting)
+        self.stats.gauge("signals", lambda: dict(self.signals))
+        self.stats.gauge("proxyCount", lambda: self.n_proxies)
         process.register(f"master.getRate#{uid}", self.get_rate)
+        process.register(f"ratekeeper.metrics#{uid}", self._metrics)
 
-    async def get_rate(self, _req) -> float:
-        return self.rate
+    # back-compat scalar (status/tests read a single released rate)
+    @property
+    def rate(self) -> float:
+        return self.rates["default"]
+
+    async def get_rate(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — rate poll
+        """The proxies' getRate poll (MasterProxyServer.actor.cpp:85):
+        per-class rates already split across the proxy fleet."""
+        return {
+            "per_proxy": {
+                k: v / self.n_proxies for k, v in self.rates.items()
+            },
+            "cluster": dict(self.rates),
+            "released": self.rates["default"],
+            "limiting": self.limiting,
+        }
+
+    async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
+        return self.stats.snapshot()
 
     async def run(self):
+        interval = self.knobs.RK_POLL_INTERVAL
         while True:
-            await delay(0.5)
-            lags = []
-            for s in self.storage:
+            await delay(interval)
+            try:
+                sig = await self._poll_signals()
+            except Cancelled:
+                raise  # actor-cancelled-swallow
+            except Exception as e:
+                trace(
+                    SevWarn, "RatekeeperPollError", self.process.address,
+                    Err=repr(e),
+                )
+                continue
+            if sig is None:
+                continue
+            self.signals = sig
+            self._c_loops.add()
+            target, limiting = compute_rates(self.knobs, sig)
+            a = self.knobs.RK_RATE_SMOOTHING
+            for k, v in target.items():
+                self.rates[k] += a * (v - self.rates[k])
+            self.limiting = limiting
+
+    # -- signal collection -----------------------------------------------------
+
+    async def _poll_signals(self):
+        """One control sample over the LIVE cluster: registry → per-worker
+        role metrics. Falls back to direct polls of the seed storage set
+        when the registry is unreachable (early recovery, partitions)."""
+        snaps = await self._registry_snapshots()
+        if snaps is None:
+            return await self._poll_seed_storage()
+        sig: dict = {
+            "version_lag": None,
+            "durability_lag": None,
+            "tlog_queue_bytes": None,
+            "busy_fraction": None,
+            "band_overrun": None,
+            "kernel_state": None,
+            "storage_count": 0,
+        }
+        from ..conflict.failover import health_rank
+
+        band_now: dict[str, tuple] = {}
+        worst_kernel = None
+        for role_snaps, proc_snap in snaps:
+            for rid, snap in (role_snaps or {}).items():
+                kind = snap.get("kind")
+                if kind == "storage":
+                    v = snap.get("version") or 0
+                    d = snap.get("durableVersion") or 0
+                    sig["storage_count"] += 1
+                    lag = self.master.last_assigned - v
+                    dlag = v - d
+                    if sig["version_lag"] is None or lag > sig["version_lag"]:
+                        sig["version_lag"] = lag
+                    if (
+                        sig["durability_lag"] is None
+                        or dlag > sig["durability_lag"]
+                    ):
+                        sig["durability_lag"] = dlag
+                elif kind == "tlog":
+                    q = max(snap.get("queueBytes") or 0, snap.get("memBytes") or 0)
+                    if (
+                        sig["tlog_queue_bytes"] is None
+                        or q > sig["tlog_queue_bytes"]
+                    ):
+                        sig["tlog_queue_bytes"] = q
+                elif kind == "resolver":
+                    h = (snap.get("kernel") or {}).get("health") or {}
+                    state = h.get("state")
+                    if state and (
+                        worst_kernel is None
+                        or health_rank(state) > health_rank(worst_kernel)
+                    ):
+                        worst_kernel = state
+                elif kind == "proxy":
+                    above = total = 0
+                    for key in ("grvLatencyBands", "commitLatencyBands"):
+                        b = snap.get(key) or {}
+                        total += b.get("count") or 0
+                        for edge, n in (b.get("bands") or {}).items():
+                            e = float("inf") if edge == "inf" else float(edge)
+                            if e > self.knobs.RK_BAND_SLO:
+                                above += n
+                    band_now[rid] = (above, total)
+            if proc_snap and proc_snap.get("personality") == "real":
+                bf = proc_snap.get("busy_fraction") or 0.0
+                if sig["busy_fraction"] is None or bf > sig["busy_fraction"]:
+                    sig["busy_fraction"] = bf
+        sig["kernel_state"] = worst_kernel
+        # band overrun over the interval: diff cumulative per-proxy totals
+        d_above = d_total = 0
+        for rid, (above, total) in band_now.items():
+            pa, pt = self._band_last.get(rid, (0, 0))
+            if total >= pt:  # proxy restart resets its bands
+                d_above += above - pa
+                d_total += total - pt
+        self._band_last = band_now
+        if d_total > 0:
+            sig["band_overrun"] = d_above / d_total
+        if sig["storage_count"] == 0:
+            # registry answered but no storage metrics yet — seed fallback
+            seeded = await self._poll_seed_storage()
+            if seeded is not None:
+                sig["version_lag"] = seeded["version_lag"]
+                sig["durability_lag"] = seeded["durability_lag"]
+                sig["storage_count"] = seeded["storage_count"]
+        return sig
+
+    async def _registry_snapshots(self):
+        """[(worker.metrics snapshot, process.metrics snapshot)] for every
+        live registered worker, or None when the CC is unreachable."""
+        if not self.cc_address:
+            return None
+        try:
+            reply = await timeout(
+                self.process.request(
+                    Endpoint(self.cc_address, Tokens.CC_GET_WORKERS),
+                    None,
+                ),
+                1.0,
+            )
+        except Cancelled:
+            raise  # actor-cancelled-swallow
+        except Exception:
+            reply = None
+        if reply is None or not reply.workers:
+            self._c_registry_err.add()
+            return None
+        self._c_registry.add()
+
+        async def pull(address):
+            async def one(token):
                 try:
-                    r = await timeout(self.process.request(s.ep("version"), None), 0.5)
+                    return await timeout(
+                        self.process.request(Endpoint(address, token), None),
+                        1.0,
+                    )
                 except Cancelled:
                     raise  # actor-cancelled-swallow
                 except Exception:
-                    continue
-                if r is not None:
-                    version, _durable, _epoch = r
-                    lags.append(self.master.last_assigned - version)
-            if not lags:
+                    return None
+
+            mf = self.process.spawn(one("worker.metrics"))
+            pf = self.process.spawn(one("process.metrics"))
+            return await mf, await pf
+
+        from ..runtime.futures import wait_for_all
+
+        return await wait_for_all(
+            [self.process.spawn(pull(d.address)) for d in reply.workers]
+        )
+
+    async def _poll_seed_storage(self):
+        """The pre-registry fallback: direct version polls of the storage
+        interfaces this Ratekeeper was constructed with."""
+        self._c_fallback.add()
+        lags, dlags = [], []
+        for s in self.storage:
+            try:
+                r = await timeout(
+                    self.process.request(s.ep("version"), None), 0.5
+                )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
+            except Exception:
                 continue
-            worst = max(lags)
-            lo = self.knobs.RK_LAG_TARGET
-            hi = self.knobs.RK_LAG_MAX
-            if worst <= lo:
-                factor = 1.0
-            elif worst >= hi:
-                factor = 0.05  # never fully zero: progress drains the lag
-            else:
-                factor = max(0.05, 1.0 - (worst - lo) / (hi - lo))
-            self.rate = self.knobs.RK_MAX_TPS * factor
+            if r is not None:
+                version, durable, _epoch = r
+                lags.append(self.master.last_assigned - version)
+                dlags.append(version - durable)
+        if not lags:
+            return None
+        return {
+            "version_lag": max(lags),
+            "durability_lag": max(dlags),
+            "tlog_queue_bytes": None,
+            "busy_fraction": None,
+            "band_overrun": None,
+            "kernel_state": None,
+            "storage_count": len(lags),
+        }

@@ -79,7 +79,17 @@ class ReportRawCommittedVersionRequest:
 
 @dataclass
 class GetReadVersionRequest:
-    pass
+    """GRV envelope. ``priority`` is a transaction priority class
+    (server/admission.py: 0=batch, 1=default, 2=immediate) and ``tenant``
+    an opaque tenant id — both consumed by the proxy's admission queue
+    (per-class and per-tenant token buckets; Ratekeeper-grade admission,
+    ISSUE 13). Empty tenant = untenanted (class bucket only). ``count``
+    is how many client transactions share this coalesced request (the
+    reference's transactionCount): admission debits that many tokens."""
+
+    priority: int = 1  # PRIORITY_DEFAULT
+    tenant: str = ""
+    count: int = 1
 
 
 @dataclass

@@ -774,6 +774,12 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
     dd_db = Database(
         process.sim, client_addr=process.address, proxy_ifaces=list(proxy_ifaces)
     )
+    # system traffic: DD repair/tracker transactions ride the IMMEDIATE
+    # admission class — shard repair must keep running while client load
+    # is being shed (server/admission.py)
+    from .admission import PRIORITY_IMMEDIATE
+
+    dd_db.default_priority = PRIORITY_IMMEDIATE
     addr_zone = {
         w.address: (getattr(w, "zone", "") or w.address) for w in workers
     }
@@ -786,7 +792,15 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         uid=f"dd-{uid}-{recovery_count}",
         zones={s.tag: addr_zone.get(s.address, s.address) for s in storage},
     )
-    rk = Ratekeeper(process, master, storage, knobs, uid)
+    rk = Ratekeeper(
+        process,
+        master,
+        storage,
+        knobs,
+        uid,
+        cc_address=cc_address,  # live membership: poll the CC registry
+        n_proxies=len(proxy_ifaces),
+    )
     watched = (
         [(i.ep("ping"), "proxy") for i in proxy_ifaces]
         + [(i.ep("ping"), "resolver") for i in resolver_ifaces]
@@ -808,6 +822,9 @@ async def master_core(process, uid: str, coordinators, cc_address, initial_confi
         ),
         process.spawn(dd.run()),
         process.spawn(rk.run()),
+        process.spawn(
+            rk.stats.trace_loop(knobs.METRICS_TRACE_INTERVAL, process.address)
+        ),
         process.spawn(balancer.run(process)),
     ]
     try:

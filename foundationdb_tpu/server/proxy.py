@@ -33,12 +33,12 @@ from __future__ import annotations
 import struct
 
 from ..conflict.api import Verdict
-from ..errors import NotCommitted, TransactionTooOld
+from ..errors import GrvThrottled, NotCommitted, TransactionTooOld
+from .admission import GrvAdmission
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
 from ..net.sim import BrokenPromise, Endpoint
 from ..runtime.futures import (
-    AsyncTrigger,
     Future,
     RequestBatcher,
     VersionGate,
@@ -219,9 +219,6 @@ class Proxy:
         self._gcv_num = 0  # requestNum sequence for pipelined version asks
         self._resolving_gate = VersionGate(0)
         self._logging_gate = VersionGate(0)
-        # ratekeeper gate state (None until a getRate reply arrives)
-        self._grv_budget = None
-        self._grv_replenished = AsyncTrigger()
         # GRV batching toward the master (transactionStarter batching);
         # created lazily — self.process is bound at register() time
         self._grv_batcher = None
@@ -252,22 +249,41 @@ class Proxy:
         self._l_p1 = self.stats.latency("phase1Version")
         self._l_p2 = self.stats.latency("phase2Resolve")
         self._l_p4 = self.stats.latency("phase4LogPush")
+        # GRV admission control (server/admission.py; ISSUE 13): per-class
+        # + per-tenant token buckets fed by the Ratekeeper's getRate reply,
+        # bounded queues with deadline shedding (grv_throttled). Ungated
+        # until a getRate reply arrives (static clusters stay ungated).
+        self.admission = GrvAdmission(self.knobs, self.stats)
 
     # -- GRV -------------------------------------------------------------------
 
-    async def get_read_version(self, _req: GetReadVersionRequest) -> GetReadVersionReply:
+    async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
         self._check_alive()
         self._c_grv_in.add()
+        priority = getattr(req, "priority", 1)
+        tenant = getattr(req, "tenant", "") or ""
+        count = getattr(req, "count", 1)
         t0 = now()
-        with span("Proxy.grv", self.process.address, proxy=self.uid) as sp:
-            # ratekeeper gate: new transactions wait for budget when storage
-            # lags (transactionStarter's rate limiting, :925)
+        with span(
+            "Proxy.grv", self.process.address, proxy=self.uid,
+            priority=priority,
+        ) as sp:
+            # admission gate (server/admission.py): per-class + per-tenant
+            # token buckets replenished from the Ratekeeper grant; a waiter
+            # that can't be admitted by its class deadline (or arrives to a
+            # full queue) sheds with the typed retryable grv_throttled
+            # error — load sheds instead of latency collapsing
             t_gate = now()
-            while self._grv_budget is not None and self._grv_budget < 1.0:
-                await self._grv_replenished.on_trigger()
-                self._check_alive()
-            if self._grv_budget is not None:
-                self._grv_budget -= 1.0
+            try:
+                await self.admission.admit(priority, tenant, count)
+            except GrvThrottled:
+                if sp.sampled:
+                    emit_span(
+                        "Proxy.grvShed", self.process.address, sp, t_gate,
+                        now(),
+                    )
+                raise
+            self._check_alive()
             if sp.sampled and now() > t_gate:
                 emit_span("Proxy.grvRateGate", self.process.address, sp, t_gate, now())
             # batched: requests that arrived before the master round trip began
@@ -378,21 +394,26 @@ class Proxy:
         while True:
             await delay(interval)
             try:
-                rate = await self.process.request(self.master.ep("getRate"), None)
+                reply = await self.process.request(self.master.ep("getRate"), None)
             except Cancelled:
                 raise  # actor-cancelled-swallow
             except Exception:
-                rate = None
-            if rate is None:
+                reply = None
+            if reply is None:
                 misses += 1
-                if misses >= 4 and self._grv_budget is not None:
-                    self._grv_budget = None
-                    self._grv_replenished.trigger()
+                if misses >= 4 and self.admission.rates is not None:
+                    self.admission.set_rates(None)
                 continue
             misses = 0
-            have = self._grv_budget or 0.0
-            self._grv_budget = min(have + rate * interval, 2 * rate * interval)
-            self._grv_replenished.trigger()
+            # per-class per-proxy rates (ISSUE 13); a legacy scalar reply
+            # gates every class at the same rate
+            if isinstance(reply, dict):
+                self.admission.set_rates(reply.get("per_proxy") or {})
+            else:
+                r = float(reply)
+                self.admission.set_rates(
+                    {"batch": r, "default": r, "immediate": r}
+                )
 
     # -- key location ----------------------------------------------------------
 
@@ -511,8 +532,8 @@ class Proxy:
             # kill the hosting worker process on a real server
             # (die-on-actor-error), taking co-hosted roles with it
             self.failed = True
-            # wake GRVs parked on the rate gate so they see failure
-            self._grv_replenished.trigger()
+            # wake GRVs parked on the admission gate so they see failure
+            self.admission.fail_all()
             for f in replies:
                 if not f.is_ready():
                     f._set_error(BrokenPromise(str(e)))
@@ -1016,9 +1037,10 @@ class Proxy:
 
     def close(self) -> None:
         """Role retirement (worker._destroy): fail fast so parked GRVs
-        (peer-confirm loops) error out instead of outliving the role."""
+        (admission queue + peer-confirm loops) error out instead of
+        outliving the role."""
         self.failed = True
-        self._grv_replenished.trigger()
+        self.admission.fail_all()
 
     async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         return self.stats.snapshot()
@@ -1038,6 +1060,7 @@ class Proxy:
         process.register(f"proxy.metrics#{self.uid}", self._metrics)
         process.register(f"proxy.rawCommitted#{self.uid}", self._raw_committed)
         process.spawn(self.batcher_loop())
+        process.spawn(self.admission.pump())
         process.spawn(self.stats.trace_loop(5.0, process.address))
 
     def register_instance(self, process) -> None:

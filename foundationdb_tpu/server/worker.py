@@ -476,6 +476,7 @@ class Worker:
         pr.register_instance(self.process)
         self._spawn(h, pr.batcher_loop())
         self._spawn(h, pr.rate_poller())
+        self._spawn(h, pr.admission.pump())
         self._spawn(
             h,
             pr.stats.trace_loop(

@@ -189,6 +189,45 @@ class FdbCli:
                     else ""
                 )
             )
+            rpc = qos.get("released_per_class") or {}
+            apc = qos.get("admitted_per_class") or {}
+            if rpc or apc:
+                parts = []
+                for c in ("batch", "default", "immediate"):
+                    granted = rpc.get(c)
+                    admitted = (apc.get(c) or {}).get("hz") or 0
+                    parts.append(
+                        f"{c} {admitted:.0f}/s"
+                        + (
+                            f" (granted {granted:.0f})"
+                            if granted is not None
+                            else ""
+                        )
+                    )
+                lines.append("Admission: " + ", ".join(parts))
+            shed = qos.get("throttled_total") or 0
+            if shed:
+                tpc = qos.get("throttled_per_class") or {}
+                lines.append(
+                    f"Throttled: {shed} shed ("
+                    + ", ".join(
+                        f"{c} {tpc.get(c, 0)}"
+                        for c in ("batch", "default", "immediate")
+                    )
+                    + ")"
+                )
+            tenants = qos.get("tenants") or {}
+            if tenants:
+                tparts = [
+                    f"{t or '<none>'}: {s.get('admitted', 0)} adm"
+                    + (
+                        f"/{s.get('throttled', 0)} shed"
+                        if s.get("throttled")
+                        else ""
+                    )
+                    for t, s in tenants.items()
+                ]
+                lines.append("Tenants (top): " + ", ".join(tparts))
         lines.extend(_format_run_loop(doc.get("run_loop") or {}))
         if args and args[0] == "details":
             # machine/process sections (fdbcli `status details`)

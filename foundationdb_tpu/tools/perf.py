@@ -105,6 +105,170 @@ def run_sim(args) -> dict:
     return report
 
 
+def run_overload(args) -> dict:
+    """--overload-factor N: the admission-control overload A/B (ISSUE 13).
+
+    One simulated DynamicCluster (master-hosted Ratekeeper + CC status):
+    phase A calibrates peak capacity with a default-class Throughput run,
+    then RK_MAX_TPS pins to that capacity and phase B offers ~N× the
+    load (mixed batch/default across tenants, plus a default-class
+    goodput probe population). Reports goodput vs peak, shed counts, and
+    admitted-traffic p95 — with the cluster's qos / workload /
+    latency_probe status sections embedded as evidence.
+
+    --no-admission runs the B leg with shedding disabled (an effectively
+    unbounded, deadline-free queue — the pre-ISSUE-13 park-forever gate)
+    for the collapse side of the A/B."""
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..client.database import Database
+    from ..client import management
+    from ..net.sim import Sim
+    from ..runtime.futures import spawn
+    from ..runtime.rng import DeterministicRandom
+    from ..server.cluster import ClusterConfig, DynamicCluster
+    from ..workloads import run_workloads
+    from ..workloads.readwrite import ThroughputWorkload
+
+    sim = Sim(seed=args.seed)
+    sim.activate()
+    sim.knobs.SIM_FAST_LATENCY = 0.00025
+    sim.knobs.SIM_MAX_LATENCY = 0.001
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_proxies=2, n_resolvers=1, n_tlogs=1, n_storage=1)
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    rng = DeterministicRandom(args.seed)
+    duration = args.duration if args.duration > 0 else 3.0
+    # bound the sim-side keyspace population cost: overload measures the
+    # admission path, not bulk ingest
+    ks = min(args.keyspace, 2000)
+    from ..runtime.loop import now as sim_now
+
+    def sim_run(workloads, limit=36000.0):
+        async def go():
+            await run_workloads(workloads)
+            return True
+
+        sim.run_until_done(spawn(go()), limit)
+
+    # phase A: peak capacity, default class, modest concurrency
+    w_cal = ThroughputWorkload(
+        db, rng.fork(), duration=duration, actors=args.actors,
+        reads_per_txn=1, writes_per_txn=1, keyspace=ks,
+        now_fn=sim_now,
+    )
+    t0 = sim_now()
+    sim_run([w_cal])
+    cal_elapsed = max(sim_now() - t0, 1e-9)
+    capacity = w_cal.rec.commits / cal_elapsed
+    # pin the Ratekeeper to defend the measured capacity WITH headroom
+    # (the reference grants below saturation so admitted traffic keeps
+    # its latency inside bands); proxies pick the new grant up within
+    # one poll interval. Let the smoothed rates settle onto the pinned
+    # ceiling before the overload leg starts.
+    # 0.5x: decisively below the cluster's latency-backpressure point, so
+    # the GATE (not commit-path queueing) is what the overload leg hits —
+    # the A/B then measures admission behavior, not batching elasticity
+    defended = capacity * 0.5
+    sim.knobs.RK_MAX_TPS = max(defended, 1.0)
+    from ..runtime.futures import delay as _delay
+
+    async def settle():
+        await _delay(5.0)
+        return True
+
+    sim.run_until_done(spawn(settle()), 600.0)
+    if args.no_admission:
+        # the collapse leg: no deadline, no bound — waiters park forever
+        sim.knobs.RK_GRV_QUEUE_TIMEOUT = 1e9
+        sim.knobs.RK_GRV_QUEUE_MAX = 1 << 30
+
+    # phase B: ~factor× offered load. Scale offered load by actor count
+    # (each calibration actor saturated its pipeline depth already)
+    factor = max(args.overload_factor, 1.0)
+    n_flood = max(int(args.actors * factor) - args.actors, 1)
+    # floods carry one read each: a write-only transaction never takes
+    # a GRV (read_snapshot=0), so it would bypass admission entirely
+    flood_batch = ThroughputWorkload(
+        db, rng.fork(), duration=duration, actors=(n_flood + 1) // 2,
+        reads_per_txn=1, writes_per_txn=1, keyspace=ks,
+        now_fn=sim_now, priority="batch", tenant="flood-batch",
+        prefix=b"ovb/",
+    )
+    flood_default = ThroughputWorkload(
+        db, rng.fork(), duration=duration, actors=n_flood // 2 or 1,
+        reads_per_txn=1, writes_per_txn=1, keyspace=ks,
+        now_fn=sim_now, priority="default", tenant="flood-default",
+        prefix=b"ovd/",
+    )
+    # the admitted-traffic population whose goodput/p95 the acceptance
+    # criteria cite: default class, its own tenant
+    w_load = ThroughputWorkload(
+        db, rng.fork(), duration=duration, actors=args.actors,
+        reads_per_txn=1, writes_per_txn=1, keyspace=ks,
+        now_fn=sim_now, priority="default", tenant="app",
+    )
+    t0 = sim_now()
+    sim_run([w_load, flood_batch, flood_default])
+    b_elapsed = max(sim_now() - t0, 1e-9)
+    goodput = (
+        w_load.rec.commits + flood_batch.rec.commits + flood_default.rec.commits
+    ) / b_elapsed
+
+    # cluster-side evidence: qos (throttled/released per class), workload
+    # (latency bands), latency_probe (immediate-class probe percentiles)
+    async def fetch_status():
+        return await management.get_status(cluster.coordinators, db.client)
+
+    status_fut = spawn(fetch_status())
+    sim.run_until_done(status_fut, 600.0)
+    doc = status_fut.get() or {}
+    cl = sorted(w_load.rec.commit_lat)
+    fl = sorted(flood_batch.rec.commit_lat + flood_default.rec.commit_lat)
+    # each flood txn's FIRST read pays the GRV (admission) wait — this is
+    # where an unbounded park shows up as latency collapse
+    fr = sorted(flood_batch.rec.read_lat + flood_default.rec.read_lat)
+    report = {
+        "workload": "overload",
+        "overload_factor": round(factor, 2),
+        "capacity_txn_s": round(capacity, 1),
+        "defended_txn_s": round(defended, 1),
+        "goodput_txn_s": round(goodput, 1),
+        "goodput_ratio": round(goodput / max(defended, 1e-9), 3),
+        "admitted_commit_p50_ms": round(_w_pct(cl, 0.50) * 1000, 3),
+        "admitted_commit_p95_ms": round(_w_pct(cl, 0.95) * 1000, 3),
+        "admitted_commits": w_load.rec.commits,
+        "flood_commits": flood_batch.rec.commits + flood_default.rec.commits,
+        # the flood population is where the OFF leg's collapse shows:
+        # parked-forever GRVs turn into unbounded commit latency here
+        "flood_commit_p50_ms": round(_w_pct(fl, 0.50) * 1000, 3),
+        "flood_commit_p95_ms": round(_w_pct(fl, 0.95) * 1000, 3),
+        "flood_read_p50_ms": round(_w_pct(fr, 0.50) * 1000, 3),
+        "flood_read_p95_ms": round(_w_pct(fr, 0.95) * 1000, 3),
+        "batch_flood_commits": flood_batch.rec.commits,
+        "admission": "off" if args.no_admission else "on",
+        "status": {
+            k: doc.get(k) for k in ("qos", "workload", "latency_probe")
+        },
+    }
+    prof = getattr(sim.loop, "profiler", None)
+    if prof is not None:
+        report["run_loop"] = prof.snapshot(top=5)
+    return report
+
+
+def _w_pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * p))]
+
+
 def make_workload(args, db, rng, now_fn=None):
     from ..workloads.readwrite import (
         BulkLoadWorkload,
@@ -306,6 +470,19 @@ def main(argv=None) -> int:
         help="tcp mode: embed the cluster's workload/latency_probe/qos "
              "status sections in the report",
     )
+    ap.add_argument(
+        "--overload-factor", type=float, default=0.0, dest="overload_factor",
+        help="> 0: admission-control overload driver (sim DynamicCluster "
+             "with a live Ratekeeper): calibrate peak capacity, offer "
+             "~N x that load mixed across classes/tenants, embed "
+             "qos/workload/latency_probe status evidence",
+    )
+    ap.add_argument(
+        "--no-admission", action="store_true", dest="no_admission",
+        help="overload driver: disable shedding (unbounded deadline-free "
+             "queue — the pre-admission park-forever gate) for the "
+             "collapse leg of the A/B",
+    )
     ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
     ap.add_argument("--client-id", type=int, default=0, dest="client_id")
     ap.add_argument("--coordinators", default=None)
@@ -321,6 +498,11 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    if args.overload_factor > 0:
+        report = run_overload(args)
+        report["mode"] = "sim"
+        print(json.dumps(report), flush=True)
+        return 0
     if args.mode == "sim":
         report = run_sim(args)
     elif args.mode == "tcp":

@@ -82,7 +82,10 @@ def random_config(rng) -> tuple[ClusterConfig, int, int]:
 
 
 def run_one(
-    seed: int, verbose: bool = False, force_kernel_faults: bool = False
+    seed: int,
+    verbose: bool = False,
+    force_kernel_faults: bool = False,
+    force_overload: bool = False,
 ) -> dict:
     """One randomized chaos run; raises on any check failure."""
     knobs = Knobs()
@@ -191,6 +194,22 @@ def run_one(
     # rotation above reproduce exactly; client knobs are consulted at
     # read time, so setting them after cluster construction is live
     knobs.randomize_read_pipeline(shape_rng)
+    # admission-control draws ride at the very END of the sequence for
+    # the same pinned-seed reason (PR 12's lesson): overload burst arm +
+    # queue/shed/tenant knob randomization (ISSUE 13). Admission knobs
+    # are consulted live by proxies/ratekeeper at poll time. The
+    # composition case — attrition + kernel fault injection + overload —
+    # falls out whenever the earlier draws armed those too.
+    overload = force_overload or shape_rng.coinflip(0.3)
+    if overload:
+        from ..workloads import OverloadBurstWorkload
+
+        # insert BEFORE the trailing ConsistencyCheck (it must stay last)
+        workloads.insert(
+            len(workloads) - 1,
+            OverloadBurstWorkload(db, rng.fork(), actors=4, txns=5),
+        )
+    knobs.randomize_admission(shape_rng)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)
@@ -201,7 +220,8 @@ def run_one(
             f"t{cfg.n_tlogs} s{cfg.n_storage}x{cfg.replication} "
             f"zones={n_zones} coords={n_coordinators} kills={kills} "
             f"backend={cfg.conflict_backend}"
-            f"{' faults=on' if knobs.CONFLICT_FAULT_INJECTION else ''} "
+            f"{' faults=on' if knobs.CONFLICT_FAULT_INJECTION else ''}"
+            f"{' overload=on' if overload else ''} "
             f"buggify_fired={fired}"
         )
         kernel = [s for s in sites if s.startswith("kernel-")]
@@ -212,6 +232,7 @@ def run_one(
         "buggify_fired": fired,
         "buggify_sites": sites,
         "kernel_faults_armed": bool(knobs.CONFLICT_FAULT_INJECTION),
+        "overload_armed": bool(overload),
         "config": cfg.as_dict(),
     }
 

@@ -62,3 +62,4 @@ from .chaos_extra import (  # noqa: E402,F401
     RollbackWorkload,
 )
 from .kernel_chaos import KernelChaosWorkload  # noqa: E402,F401
+from .overload import OverloadBurstWorkload  # noqa: E402,F401

@@ -97,6 +97,8 @@ class ReadWriteWorkload(Workload):
         prefix=b"rw/",
         now_fn=None,
         parallel_reads=False,
+        priority=None,
+        tenant=None,
         **kw,
     ):
         super().__init__(db, rng, **kw)
@@ -111,6 +113,10 @@ class ReadWriteWorkload(Workload):
         # clients pipeline their gets; with the read coalescer this is
         # what collapses a txn's N gets into one multiGet hop)
         self.parallel_reads = parallel_reads
+        # admission options (ISSUE 13): the overload drivers run this
+        # shape per priority class / tenant; None = database defaults
+        self.priority = priority
+        self.tenant = tenant
         if now_fn is None:
             from ..runtime.loop import now as now_fn
         self.rec = _Recorder(now_fn)
@@ -137,7 +143,9 @@ class ReadWriteWorkload(Workload):
     async def _one_txn(self, rnd):
         rec = self.rec
         for attempt in range(20):
-            tr = self.db.transaction()
+            tr = self.db.transaction(
+                priority=self.priority, tenant=self.tenant
+            )
             try:
                 if self.parallel_reads and self.reads_per_txn > 1:
                     keys = [

@@ -1,0 +1,532 @@
+"""Ratekeeper-grade admission control (ISSUE 13): priority classes,
+per-tenant token buckets, shed-don't-collapse overload behavior.
+
+Batteries:
+- compute_rates: the pure multi-signal controller (every signal throttles;
+  kernel DEGRADED tightens; shed order batch -> default -> immediate);
+- GrvAdmission unit behavior on a deterministic sim loop (starvation,
+  tenant fair-share, deadline shedding, proxy-death wakeup, Cancelled
+  cleanup — the GRV gate wakeup satellite);
+- client plumbing (priority/tenant on the envelope, bounded throttle
+  backoff — the regression test alongside flowlint's
+  actor-unbounded-retry rule);
+- end-to-end: a DynamicCluster overload run that sheds instead of
+  collapsing, with the evidence visible in the status document's qos
+  section; live-membership discovery by the Ratekeeper.
+"""
+
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.errors import GrvThrottled
+from foundationdb_tpu.net.sim import BrokenPromise, Sim
+from foundationdb_tpu.runtime.futures import delay, spawn, wait_for_all
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.stats import CounterCollection
+from foundationdb_tpu.server.admission import (
+    PRIORITY_BATCH,
+    PRIORITY_DEFAULT,
+    PRIORITY_IMMEDIATE,
+    GrvAdmission,
+    coerce_priority,
+)
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.server.data_distribution import Ratekeeper, compute_rates
+
+
+def make(seed=0, knob_overrides=None, **cfg):
+    knobs = Knobs(**(knob_overrides or {}))
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(sim, ClusterConfig(**cfg))
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    return sim, cluster, db
+
+
+def run(sim, coro, limit=600.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+HEALTHY = {
+    "version_lag": 0,
+    "durability_lag": 0,
+    "tlog_queue_bytes": 0,
+    "busy_fraction": 0.1,
+    "band_overrun": 0.0,
+    "kernel_state": "HEALTHY",
+}
+
+
+# -- pure controller -----------------------------------------------------------
+
+
+def test_compute_rates_healthy_full_rates():
+    k = Knobs()
+    rates, limiting = compute_rates(k, dict(HEALTHY))
+    assert limiting == "workload"
+    assert rates["default"] == k.RK_MAX_TPS
+    assert rates["batch"] == k.RK_MAX_TPS
+    assert rates["immediate"] == k.RK_MAX_TPS
+
+
+def test_compute_rates_kernel_degraded_tightens():
+    """A DEGRADED conflict kernel must tighten admission instead of
+    queueing resolve batches into the dispatch deadline."""
+    k = Knobs()
+    healthy, _ = compute_rates(k, dict(HEALTHY))
+    degraded, limiting = compute_rates(
+        k, dict(HEALTHY, kernel_state="DEGRADED")
+    )
+    assert limiting == "kernel_degraded"
+    assert degraded["default"] == healthy["default"] * k.RK_KERNEL_DEGRADED_FACTOR
+    # batch bites twice (sheds first)
+    assert degraded["batch"] < degraded["default"]
+    # immediate unaffected by DEGRADED (failover still serves)
+    assert degraded["immediate"] == healthy["immediate"]
+    failed, _ = compute_rates(k, dict(HEALTHY, kernel_state="FAILED"))
+    assert failed["default"] < degraded["default"]
+    assert failed["immediate"] < healthy["immediate"]
+
+
+def test_compute_rates_each_signal_throttles():
+    k = Knobs()
+    cases = {
+        "storage_version_lag": dict(
+            HEALTHY, version_lag=(k.RK_LAG_TARGET + k.RK_LAG_MAX) // 2
+        ),
+        "storage_durability_lag": dict(
+            HEALTHY,
+            durability_lag=(k.RK_DURABILITY_LAG_TARGET + k.RK_DURABILITY_LAG_MAX)
+            // 2,
+        ),
+        "tlog_queue": dict(
+            HEALTHY,
+            tlog_queue_bytes=(k.RK_TLOG_QUEUE_TARGET + k.RK_TLOG_QUEUE_MAX) // 2,
+        ),
+        "run_loop_busy": dict(
+            HEALTHY,
+            busy_fraction=(k.RK_BUSY_FRACTION_TARGET + k.RK_BUSY_FRACTION_MAX)
+            / 2,
+        ),
+        "latency_bands": dict(
+            HEALTHY,
+            band_overrun=(k.RK_BAND_OVERRUN_TARGET + k.RK_BAND_OVERRUN_MAX) / 2,
+        ),
+    }
+    for expect, sig in cases.items():
+        rates, limiting = compute_rates(k, sig)
+        assert limiting == expect, (expect, limiting)
+        assert rates["default"] < k.RK_MAX_TPS, expect
+        # shed order: batch throttles at least as hard as default
+        assert rates["batch"] <= rates["default"], expect
+        # immediate unaffected by ordinary duress
+        assert rates["immediate"] == k.RK_MAX_TPS, expect
+
+
+def test_compute_rates_floors_and_immediate_mvcc_danger():
+    k = Knobs()
+    # everything past max: default floors, batch goes to zero
+    sig = dict(
+        HEALTHY,
+        version_lag=k.RK_LAG_MAX,
+        durability_lag=k.RK_DURABILITY_LAG_MAX * 2,
+        tlog_queue_bytes=k.RK_TLOG_QUEUE_MAX * 2,
+    )
+    rates, _ = compute_rates(k, sig)
+    assert rates["default"] == k.RK_MAX_TPS * k.RK_RATE_FLOOR
+    assert rates["batch"] == 0.0
+    # immediate starts draining only past RK_LAG_MAX (MVCC danger zone)
+    assert rates["immediate"] == k.RK_MAX_TPS
+    sig["version_lag"] = (
+        k.RK_LAG_MAX + k.MAX_READ_TRANSACTION_LIFE_VERSIONS
+    ) // 2
+    rates, _ = compute_rates(k, sig)
+    assert rates["immediate"] < k.RK_MAX_TPS
+    # unknown signals (None) are treated as healthy, not as overload
+    rates, limiting = compute_rates(k, {})
+    assert limiting == "workload" and rates["default"] == k.RK_MAX_TPS
+
+
+def test_coerce_priority():
+    assert coerce_priority("batch") == PRIORITY_BATCH
+    assert coerce_priority("immediate") == PRIORITY_IMMEDIATE
+    assert coerce_priority("nonsense") == PRIORITY_DEFAULT
+    assert coerce_priority(None) == PRIORITY_DEFAULT
+    assert coerce_priority(99) == PRIORITY_IMMEDIATE
+    assert coerce_priority(-3) == PRIORITY_BATCH
+
+
+# -- GrvAdmission unit behavior ------------------------------------------------
+
+
+def _admission(sim, **knob_overrides):
+    for k, v in knob_overrides.items():
+        setattr(sim.knobs, k, v)
+    stats = CounterCollection("Proxy", "t")
+    adm = GrvAdmission(sim.knobs, stats)
+    p = sim.new_process("adm-test")
+    p.spawn(adm.pump())
+    return adm, stats
+
+
+def test_batch_flood_cannot_starve_immediate():
+    """Starvation acceptance: with batch granted 0 and a deep batch
+    queue parked, immediate-class requests are admitted promptly while
+    every batch waiter sheds (batch 100% shed, immediate p95 bounded)."""
+    sim = Sim(seed=3)
+    sim.activate()
+    adm, _stats = _admission(sim)
+    adm.set_rates({"batch": 0.0, "default": 1000.0, "immediate": 1000.0})
+
+    from foundationdb_tpu.runtime.loop import now
+
+    results = {"batch": [], "immediate": []}
+
+    async def one(cls, bucket):
+        t0 = now()
+        try:
+            await adm.admit(cls, "")
+            results[bucket].append(("ok", now() - t0))
+        except GrvThrottled:
+            results[bucket].append(("shed", now() - t0))
+
+    async def body():
+        floods = [spawn(one(PRIORITY_BATCH, "batch")) for _ in range(40)]
+        await delay(0.01)  # the flood parks first
+        probes = [spawn(one(PRIORITY_IMMEDIATE, "immediate")) for _ in range(10)]
+        await wait_for_all(floods + probes)
+
+    sim.run_until_done(spawn(body()), 60.0)
+    assert all(r[0] == "shed" for r in results["batch"]), results["batch"][:3]
+    assert all(r[0] == "ok" for r in results["immediate"])
+    # immediate admitted promptly (well under its own queue deadline)
+    worst = max(r[1] for r in results["immediate"])
+    assert worst < sim.knobs.RK_GRV_QUEUE_TIMEOUT, worst
+    # batch shed AT its deadline, not after an unbounded park
+    batch_deadline = sim.knobs.RK_GRV_QUEUE_TIMEOUT * 0.5
+    assert all(r[1] <= batch_deadline + 0.1 for r in results["batch"])
+
+
+def test_tenant_fair_share_hot_tenant_cannot_starve_cold():
+    sim = Sim(seed=4)
+    sim.activate()
+    adm, _stats = _admission(sim, RK_TENANT_MAX_SHARE=0.25)
+    # default class: plenty of class tokens; the TENANT share is the
+    # scarce resource (25% of 40/s = 10/s per tenant)
+    adm.set_rates({"batch": 0.0, "default": 40.0, "immediate": 40.0})
+
+    results = {"hot": [], "cold": []}
+
+    async def one(tenant, bucket):
+        try:
+            await adm.admit(PRIORITY_DEFAULT, tenant)
+            results[bucket].append("ok")
+        except GrvThrottled:
+            results[bucket].append("shed")
+
+    async def body():
+        hot = [spawn(one("hot", "hot")) for _ in range(60)]
+        await delay(0.005)  # hot tenant's flood parks first
+        cold = [spawn(one("cold", "cold")) for _ in range(5)]
+        await wait_for_all(hot + cold)
+
+    sim.run_until_done(spawn(body()), 60.0)
+    # the cold tenant rides its own bucket: everything admitted even
+    # though 60 hot waiters arrived first (no head-of-line starvation)
+    assert results["cold"] == ["ok"] * 5, results["cold"]
+    # the hot tenant is capped at its share: most of the flood sheds
+    assert results["hot"].count("shed") > 0
+    snap = adm._tenant_snapshot()
+    assert snap["hot"]["throttled"] > 0
+    assert snap["cold"]["admitted"] == 5
+
+
+def test_queue_overflow_sheds_on_arrival():
+    sim = Sim(seed=5)
+    sim.activate()
+    adm, stats = _admission(sim, RK_GRV_QUEUE_MAX=4)
+    adm.set_rates({"batch": 0.0, "default": 0.5, "immediate": 1.0})
+
+    sheds = []
+
+    async def one(i):
+        try:
+            await adm.admit(PRIORITY_DEFAULT, "")
+        except GrvThrottled as e:
+            sheds.append((i, str(e)))
+
+    async def body():
+        await wait_for_all([spawn(one(i)) for i in range(12)])
+
+    sim.run_until_done(spawn(body()), 60.0)
+    # 4 park (then shed at deadline), the rest shed immediately on a
+    # full queue; nothing hangs
+    assert len(sheds) >= 8
+    assert any("queue full" in s for _i, s in sheds)
+    assert stats.counters["grvThrottled"].value >= 8
+
+
+def test_parked_waiters_observe_proxy_death_promptly():
+    """The GRV gate wakeup satellite: fail_all must error every parked
+    waiter with BrokenPromise in zero additional sim time."""
+    sim = Sim(seed=6)
+    sim.activate()
+    adm, _stats = _admission(sim)
+    adm.set_rates({"batch": 0.0, "default": 0.0, "immediate": 0.0})
+
+    from foundationdb_tpu.runtime.loop import now
+
+    outcomes = []
+
+    async def one():
+        t0 = now()
+        try:
+            await adm.admit(PRIORITY_DEFAULT, "")
+            outcomes.append(("ok", now() - t0))
+        except BrokenPromise:
+            outcomes.append(("dead", now() - t0))
+        except GrvThrottled:
+            outcomes.append(("shed", now() - t0))
+
+    async def body():
+        waiters = [spawn(one()) for _ in range(8)]
+        await delay(0.05)  # all parked, well before the 0.5s deadline
+        adm.fail_all()
+        await wait_for_all(waiters)
+
+    sim.run_until_done(spawn(body()), 60.0)
+    assert [o[0] for o in outcomes] == ["dead"] * 8, outcomes
+    # promptly: at the fail_all instant, not at the queue deadline
+    assert all(o[1] < 0.1 for o in outcomes), outcomes
+    # a dead gate admits nothing but also blocks nothing (the caller's
+    # _check_alive raises): admit() must not hang after failure
+    post = []
+
+    async def after():
+        await adm.admit(PRIORITY_DEFAULT, "")
+        post.append("through")
+
+    sim.run_until_done(spawn(after()), 60.0)
+    assert post == ["through"]
+
+
+def test_cancelled_waiter_is_cleaned_up():
+    sim = Sim(seed=7)
+    sim.activate()
+    adm, _stats = _admission(sim)
+    adm.set_rates({"batch": 0.0, "default": 2.0, "immediate": 2.0})
+
+    async def parked():
+        await adm.admit(PRIORITY_DEFAULT, "")
+        raise AssertionError("cancelled waiter must not be admitted")
+
+    async def body():
+        w = spawn(parked())
+        await delay(0.01)
+        assert adm.has_waiters()
+        w.cancel()
+        await delay(0.01)
+        assert not adm.has_waiters()  # entry dropped, not ghost-admitted
+        # the pump keeps serving later arrivals
+        await adm.admit(PRIORITY_DEFAULT, "")
+        return True
+
+    assert sim.run_until_done(spawn(body()), 60.0)
+
+
+# -- client plumbing -----------------------------------------------------------
+
+
+def test_throttle_retry_backoff_is_bounded():
+    """Regression alongside flowlint's actor-unbounded-retry: a client
+    hammered with grv_throttled keeps a BOUNDED backoff (<=
+    CLIENT_MAX_RETRY_DELAY) and grv_throttled is retryable."""
+    sim, _cluster, db = make(seed=8, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1)
+
+    async def body():
+        assert GrvThrottled.retryable
+        tr = db.transaction(priority="batch", tenant="t-0")
+        waits = []
+        from foundationdb_tpu.runtime.loop import now
+
+        for _ in range(12):
+            t0 = now()
+            await tr.on_error(GrvThrottled())
+            waits.append(now() - t0)
+            # options survive the reset inside on_error
+            assert tr.priority == PRIORITY_BATCH and tr.tenant == "t-0"
+        cap = db.knobs.CLIENT_MAX_RETRY_DELAY
+        assert max(waits) <= cap + 1e-6, waits
+        # it actually backs off (grows toward the cap, no busy spin)
+        assert waits[-1] > waits[0]
+        return True
+
+    assert run(sim, body())
+
+
+def test_priority_and_tenant_reach_status():
+    """End-to-end plumbing: per-class admitted counters and per-tenant
+    roll-ups reach the status document's qos section; the ratekeeper
+    publishes per-class released rates."""
+    sim, cluster, db = make(
+        seed=9, n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1
+    )
+
+    async def put(priority, tenant, key):
+        async def body(tr):
+            tr.set_priority(priority)
+            tr.set_tenant(tenant)
+            await tr.get(key)  # a read forces the GRV (and admission)
+            tr.set(key, b"v")
+
+        await db.run(body)
+
+    async def body():
+        for i in range(3):
+            await put("batch", "tenant-a", b"a%d" % i)
+            await put("default", "tenant-b", b"b%d" % i)
+            await put("immediate", "", b"c%d" % i)
+        await delay(2.0)  # let rate grants and metric intervals land
+        from foundationdb_tpu.client import management
+
+        doc = await management.get_status(cluster.coordinators, db.client)
+        qos = doc["qos"]
+        adm = qos["admitted_per_class"]
+        assert adm["batch"]["counter"] >= 3, adm
+        assert adm["default"]["counter"] >= 3, adm
+        assert adm["immediate"]["counter"] >= 3, adm  # + probes/DD
+        assert "throttled_total" in qos
+        assert set(qos["released_per_class"]) == {
+            "batch", "default", "immediate",
+        }
+        assert qos["limiting"]
+        tenants = qos.get("tenants") or {}
+        assert "tenant-a" in tenants and "tenant-b" in tenants, tenants
+        assert tenants["tenant-a"]["admitted"] >= 3
+        # ratekeeper role surface: its own metrics endpoint answers
+        from foundationdb_tpu.net.sim import Endpoint
+
+        info = None
+        for p in sim.processes.values():
+            if any(t.startswith("ratekeeper.metrics#") for t in p.endpoints):
+                info = p
+                break
+        assert info is not None, "no ratekeeper.metrics endpoint registered"
+        token = next(
+            t for t in info.endpoints if t.startswith("ratekeeper.metrics#")
+        )
+        snap = await db.client.request(Endpoint(info.address, token), None)
+        assert snap["name"] == "Ratekeeper"
+        assert set(snap["rates"]) == {"batch", "default", "immediate"}
+        assert snap["controlLoops"] > 0
+        return True
+
+    assert run(sim, body())
+
+
+def test_ratekeeper_discovers_live_membership():
+    """Satellite 1: a Ratekeeper constructed with an EMPTY storage seed
+    list still sees every storage server (and the tlog/kernel signals)
+    through the CC's live worker registry — storage recruited after boot
+    is visible to lag monitoring."""
+    sim, cluster, db = make(
+        seed=10, n_proxies=1, n_resolvers=1, n_tlogs=2, n_storage=2
+    )
+
+    async def body():
+        async def touch(tr):
+            tr.set(b"k", b"v")
+
+        await db.run(touch)
+        await delay(1.0)
+        # find the live CC (worker registry owner)
+        from foundationdb_tpu.server.interfaces import Tokens
+
+        cc_addr = next(
+            a
+            for a, p in sim.processes.items()
+            if Tokens.CC_GET_WORKERS in p.endpoints
+        )
+
+        class _MasterStub:
+            last_assigned = 0
+
+        rk = Ratekeeper(
+            sim.new_process("rk-probe"),
+            _MasterStub(),
+            [],  # empty seed: discovery must come from the registry
+            sim.knobs,
+            "probe",
+            cc_address=cc_addr,
+            n_proxies=1,
+        )
+        sig = await rk._poll_signals()
+        assert sig is not None
+        assert sig["storage_count"] == 2, sig
+        assert sig["durability_lag"] is not None
+        assert sig["tlog_queue_bytes"] is not None  # tlog metrics seen
+        assert sig["kernel_state"] is not None  # resolver kernel health
+        return True
+
+    assert run(sim, body())
+
+
+# -- end-to-end overload -------------------------------------------------------
+
+
+def test_overload_sheds_and_does_not_collapse():
+    """Scaled-down overload acceptance: offered load far above a tiny
+    pinned capacity. The cluster sheds (grv_throttled observed at the
+    clients and counted in qos), admitted traffic keeps committing, and
+    the immediate-class latency probe keeps measuring (zero errors after
+    overload starts)."""
+    sim, cluster, db = make(
+        seed=11,
+        # tiny capacity so a handful of actors is a real overload
+        knob_overrides=dict(RK_MAX_TPS=60.0, RK_GRV_QUEUE_TIMEOUT=0.2),
+        n_proxies=1, n_resolvers=1, n_tlogs=1, n_storage=1,
+    )
+
+    stats = {"commits": 0, "sheds": 0}
+
+    async def flood(i, priority, tenant):
+        from foundationdb_tpu.errors import FdbError
+
+        for j in range(12):
+            async def body(tr, i=i, j=j):
+                tr.set_priority(priority)
+                tr.set_tenant(tenant)
+                await tr.get(b"ov/%d/%d" % (i, j))
+                tr.set(b"ov/%d/%d" % (i, j), b"x")
+
+            try:
+                await db.run(body, max_retries=3)
+            except (FdbError, BrokenPromise):
+                stats["sheds"] += 1
+            else:
+                stats["commits"] += 1
+
+    async def body():
+        await delay(2.0)  # let the first rate grant land (gating on)
+        floods = [
+            spawn(flood(i, "batch" if i % 2 else "default", f"t{i % 2}"))
+            for i in range(8)
+        ]
+        await wait_for_all(floods)
+        await delay(1.5)
+        from foundationdb_tpu.client import management
+
+        doc = await management.get_status(cluster.coordinators, db.client)
+        qos = doc["qos"]
+        # shed, not collapsed: commits landed AND throttles were counted
+        assert stats["commits"] > 0, stats
+        assert qos["throttled_total"] > 0, (stats, qos)
+        # shed order: batch sheds at least as much as default
+        tpc = qos["throttled_per_class"]
+        assert tpc["batch"] >= tpc["default"], tpc
+        assert tpc["immediate"] == 0, tpc
+        # the probe (immediate class) kept measuring through the overload
+        probe = doc["latency_probe"]
+        assert probe.get("grv_seconds") is not None
+        assert probe["probes_completed"] > 0
+        return True
+
+    assert run(sim, body())

@@ -104,17 +104,21 @@ def test_ratekeeper_reports_rate():
 
     async def body():
         await put(db, b"a", b"1")
-        # find the live proxy and check its rate gate engaged (a getRate
-        # reply arrived and budget is finite)
+        # find the live proxy and check its admission gate engaged (a
+        # getRate reply arrived: per-class rates installed)
         await delay(2.0)
-        budgets = [
-            h.obj._grv_budget
+        rates = [
+            h.obj.admission.rates
             for p in sim.processes.values()
             if getattr(p, "worker", None)
             for h in p.worker.roles.values()
             if h.kind == "proxy" and not h.obj.failed
         ]
-        assert budgets and all(b is not None for b in budgets), budgets
+        assert rates and all(r is not None for r in rates), rates
+        for r in rates:
+            assert set(r) == {"batch", "default", "immediate"}, r
+            # healthy cluster: every class granted a positive rate
+            assert all(v > 0 for v in r.values()), r
         assert await get(db, b"a") == b"1"
 
     run(sim, body())

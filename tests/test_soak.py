@@ -23,6 +23,19 @@ def test_buggify_fires_under_chaos():
     assert fired > 0
 
 
+def test_soak_chaos_composition_kernel_faults_plus_overload():
+    """ISSUE 13 chaos composition: kernel fault injection AND the
+    admission overload burst armed in one run — rates must adapt through
+    kernel degradation/failover while batch/default traffic sheds, with
+    zero false commits (the run's oracle-checked workloads gate that) and
+    the kernel-fault buggify sites still reachable."""
+    out = run_one(0, force_kernel_faults=True, force_overload=True)
+    assert out["kernel_faults_armed"]
+    assert out["overload_armed"]
+    kernel = [s for s in out["buggify_sites"] if s.startswith("kernel-")]
+    assert kernel, f"kernel-fault sites did not fire: {out['buggify_sites']}"
+
+
 def test_soak_reports_fired_sites_and_kernel_faults_fire():
     """Buggify coverage report (ISSUE 10): the soak summary names every
     fired site, and under the pinned seed the kernel-fault-injection
