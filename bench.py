@@ -210,6 +210,145 @@ def bench_read_pipeline():
     }))
 
 
+def bench_transport():
+    """BENCH_COMPONENT=transport: the transport v2 A/B (ISSUE 14). Three
+    evidence layers, all same-shape gen-7 vs gen-6:
+      - raw wire path: pipelined echo RPCs between two colocated worlds
+        (gen-6 sockets vs gen-7 super-frames vs gen-7 loopback) — the
+        transport isolated from the cluster;
+      - cluster rows: 90/10 and read workloads on a colocated in-process
+        TCP cluster (tools/perf --mode tcp-inproc) with the new transport
+        vs --transport-legacy, run_loop + transport snapshots embedded;
+      - a traced leg embedding the span breakdown (Client.rpc self-time).
+    Writes BENCH_r09.json next to the printed JSON line."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    actors = int(os.environ.get("BENCH_TR_ACTORS", "60"))
+    txns = int(os.environ.get("BENCH_TR_TXNS", "80"))
+
+    def run_perf(extra, workload="90_10", timeout=1800):
+        cmd = [
+            sys.executable, "-m", "foundationdb_tpu.tools.perf",
+            "--mode", "tcp-inproc", "--workload", workload,
+            "--actors", str(actors), "--txns", str(txns),
+            "--parallel-reads",
+        ] + extra
+        log("running: " + " ".join(cmd[3:]))
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+        for ln in (r.stderr or "").strip().splitlines()[-4:]:
+            log("perf| " + ln)
+        lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+        return json.loads(lines[-1]) if lines else None
+
+    def echo_bench(batching, loopback, n=6000, depth=64):
+        """Raw pipelined RPC echo between two colocated worlds."""
+        import time as _time
+
+        from foundationdb_tpu.net.sim import Endpoint
+        from foundationdb_tpu.net.tcp import RealWorld
+        from foundationdb_tpu.runtime.futures import spawn, wait_for_all
+        from foundationdb_tpu.runtime.knobs import Knobs
+        from foundationdb_tpu.runtime.loop import RealLoop, set_loop
+        import socket as _socket
+
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        knobs = Knobs(
+            TRANSPORT_FRAME_BATCHING=batching, TRANSPORT_LOOPBACK=loopback
+        )
+        loop = RealLoop(seed=1)
+        a = RealWorld(f"127.0.0.1:{free_port()}", knobs=knobs, loop=loop)
+        b = RealWorld(f"127.0.0.1:{free_port()}", knobs=knobs, loop=loop)
+
+        async def echo(x):
+            return x
+
+        b.node.register("echo", echo)
+        ep = Endpoint(b.node.address, "echo")
+
+        async def worker(i):
+            for _ in range(n // depth):
+                await a.node.request(ep, (b"key%d" % i, 12345, "value"))
+
+        async def go():
+            t0 = _time.perf_counter()
+            await wait_for_all([spawn(worker(i)) for i in range(depth)])
+            return _time.perf_counter() - t0
+
+        a.activate()
+        dt = a.run_until_done(spawn(go()), 300.0)
+        snap = a.transport_metrics.snapshot()
+        a.close()
+        b.close()
+        set_loop(None)
+        loop.close()
+        return {
+            "rpc_per_s": round(n / dt, 1),
+            "msgs_per_frame": snap["messagesPerFrame"],
+            "loopback": snap["loopbackMessages"] > 0,
+        }
+
+    echo_gen6 = echo_bench(batching=False, loopback=False)
+    echo_gen7_sock = echo_bench(batching=True, loopback=False)
+    echo_gen7_loop = echo_bench(batching=True, loopback=True)
+    log(
+        f"echo rpc/s: gen6 {echo_gen6['rpc_per_s']:.0f}, gen7-sockets "
+        f"{echo_gen7_sock['rpc_per_s']:.0f}, gen7-loopback "
+        f"{echo_gen7_loop['rpc_per_s']:.0f}"
+    )
+
+    on90 = run_perf(["--trace-sample", "0.2"])
+    off90 = run_perf(["--transport-legacy"])
+    read_on = run_perf([], workload="read")
+    read_off = run_perf(["--transport-legacy"], workload="read")
+
+    ops_on = (on90 or {}).get("ops_per_s", 0.0)
+    ops_off = (off90 or {}).get("ops_per_s", 0.0)
+    artifact = {
+        "metric": "transport_90_10_inproc_tcp",
+        "value": ops_on,
+        "unit": "ops/s",
+        "vs_baseline": round(ops_on / 107_000.0, 4),  # reference 90/10 row
+        "vs_gen6": round(ops_on / max(ops_off, 1e-9), 2),
+        "echo_rpc_vs_gen6": round(
+            echo_gen7_loop["rpc_per_s"] / max(echo_gen6["rpc_per_s"], 1e-9), 2
+        ),
+        "shape": f"tcp-inproc 90_10 x {actors} actors x {txns} txns",
+        "echo": {
+            "gen6_sockets": echo_gen6,
+            "gen7_sockets": echo_gen7_sock,
+            "gen7_loopback": echo_gen7_loop,
+        },
+        "inproc_90_10_on": on90,
+        "inproc_90_10_legacy": off90,
+        "inproc_read_on": read_on,
+        "inproc_read_legacy": read_off,
+    }
+    with open(os.path.join(repo, "BENCH_r09.json"), "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    log(
+        f"transport 90/10 inproc: ON {ops_on:.0f} ops/s vs gen-6 "
+        f"{ops_off:.0f} ops/s ({artifact['vs_gen6']:.2f}x); raw echo "
+        f"{artifact['echo_rpc_vs_gen6']:.2f}x gen-6"
+    )
+    print(json.dumps({
+        k: artifact[k]
+        for k in (
+            "metric", "value", "unit", "vs_baseline", "vs_gen6",
+            "echo_rpc_vs_gen6", "shape",
+        )
+    }))
+
+
 def bench_admission():
     """BENCH_COMPONENT=admission: the overload A/B (ISSUE 13). Two legs of
     tools/perf --overload-factor (same seed, same offered load): admission
@@ -709,6 +848,9 @@ def main():
         return
     if os.environ.get("BENCH_COMPONENT") == "read_pipeline":
         bench_read_pipeline()
+        return
+    if os.environ.get("BENCH_COMPONENT") == "transport":
+        bench_transport()
         return
     if os.environ.get("BENCH_COMPONENT") == "admission":
         bench_admission()

@@ -32,6 +32,15 @@ class BrokenPromise(Exception):
     """Request to a dead/unknown endpoint (flow's broken_promise)."""
 
 
+class TransportTruncated(BrokenPromise):
+    """A transport fault ate this request (the sim's super-frame
+    truncation / partial-flush site — ISSUE 14's chaos satellite).
+    Subclassing BrokenPromise makes it retryable through every existing
+    failure path (loadbalance rotation, commit_unknown handling) while
+    staying distinctly typed: per-request degradation, never a wedged
+    connection."""
+
+
 class Endpoint:
     """(process address, token) — fdbrpc/FlowTransport.h:28-49."""
 
@@ -121,6 +130,44 @@ class Sim:
         from ..runtime.validation import DurabilityOracle
 
         self.validation = DurabilityOracle()
+        # transport counters (net/metrics.py) — parity with RealWorld so
+        # the worker's transport.metrics endpoint answers on both
+        # personalities (sim has no frames; messages count per delivery)
+        from .metrics import TransportMetrics
+
+        self.transport_metrics = TransportMetrics("sim")
+        # transport chaos (ISSUE 14): armed EXPLICITLY with a dedicated
+        # rng (tools/soak.py draws it at the very END of its sequence) so
+        # the main chaos stream — and every pinned seed riding it — stays
+        # byte-identical whether or not faults are armed
+        self._transport_fault_rng = None
+        self._transport_fault_p = 0.0
+        self._transport_fault_windows = None
+
+    def arm_transport_faults(self, rng, p: float = 0.01, windows=None) -> None:
+        """Arm the super-frame truncation fault site: while armed, each
+        delivery is independently eaten with probability ``p``, failing
+        THAT request's reply with the typed retryable TransportTruncated
+        (the observable semantics of a torn super-frame on the real
+        transport: the lost tail's requests fail, everything else
+        proceeds). ``windows`` bounds the chaos to [(t0, t1), ...] sim-time
+        episodes — like every duration-bounded fault workload (clogging,
+        disk failure): sustained per-message loss on RECOVERY-critical
+        RPCs keeps the commit epoch in a permanent recovery storm, which
+        is an unreachable regime for a real torn flush (the connection
+        re-establishes). None = always on (unit tests)."""
+        self._transport_fault_rng = rng
+        self._transport_fault_p = p
+        self._transport_fault_windows = list(windows) if windows else None
+
+    def _transport_fault_fires(self) -> bool:
+        if self._transport_fault_rng is None:
+            return False
+        if self._transport_fault_windows is not None:
+            t = self.loop.now()
+            if not any(t0 <= t < t1 for t0, t1 in self._transport_fault_windows):
+                return False
+        return self._transport_fault_rng.coinflip(self._transport_fault_p)
 
     def disk(self, machine: str):
         """The machine's persistent SimDisk (files survive kill/reboot)."""
@@ -196,8 +243,20 @@ class Sim:
 
         span_ctx = _trace.active_span()
         reply: Future = Future()
+        self.transport_metrics.messages_sent.add(1)
+        if self._transport_fault_fires():
+            # transport-truncate chaos site: this request rode the torn
+            # tail of a super-frame — typed retryable failure for THIS
+            # caller only, delivered with reply latency like any error
+            from ..runtime.buggify import mark_fired
+
+            mark_fired(("transport", "transport-truncate"))
+            self.transport_metrics.truncation_faults.add(1)
+            self._reply_err(ep.address, src, reply, TransportTruncated(str(ep)))
+            return reply
 
         def deliver():
+            self.transport_metrics.messages_received.add(1)
             dst = self.processes.get(ep.address)
             if dst is None or not dst.alive or ep.token not in dst.endpoints:
                 # reply travels dst→src

@@ -7,6 +7,19 @@ to dead peers — behind the exact ``request()``/``register()`` surface of
 the simulator (net/sim.py), so every role runs unmodified as a real OS
 process.
 
+Transport v2 (ISSUE 14): the wire path is frame-batched and zero-copy —
+preallocated receive buffers filled by ``recv_into`` and parsed as
+``memoryview`` slices (wire.RecvBuffer), send queues with consumed-offset
+compaction instead of per-send ``del buf[:n]`` (wire.SendBuffer), and ONE
+gen-7 super-frame per connection per loop tick carrying every message
+coalesced in that tick, flushed with a vectored ``sendmsg`` when the
+socket allows. Inbound super-frames batch-dispatch: one loop step drains
+every request in the frame (futures.start_batch) instead of scheduling a
+wakeup per request. Colocated worlds in the same OS process short-circuit
+onto the in-process loopback transport (net/loopback.py) automatically.
+The ``TRANSPORT_*`` knobs keep the gen-6-shaped path (per-message frames,
+sockets everywhere) available for A/B.
+
 Topology objects:
 
 - ``RealWorld`` — one OS process's view of the cluster. Mirrors ``Sim``'s
@@ -28,11 +41,12 @@ import socket
 from typing import Any, Callable, Optional
 
 from ..errors import FdbError
-from ..runtime.futures import ActorCollection, Cancelled, Future, spawn
+from ..runtime.futures import ActorCollection, Cancelled, Future, Task, spawn, start_batch
 from ..runtime.knobs import Knobs
 from ..runtime.loop import RealLoop, TaskPriority, set_loop
 from ..runtime.trace import SevError, SevInfo, SevWarn, trace
-from . import wire
+from . import loopback, wire
+from .metrics import TransportMetrics
 from .sim import BrokenPromise, Endpoint
 
 
@@ -75,11 +89,25 @@ class _Conn:
         self.world = world
         self.sock = sock
         self.peer = peer  # peer's listen address (None until handshake)
-        self.inbuf = bytearray()
+        knobs = world.knobs
+        self.metrics = world.transport_metrics
+        self._rb = wire.RecvBuffer(
+            knobs.TRANSPORT_RECV_BYTES, knobs.TRANSPORT_COMPACT_WATERMARK
+        )
         # the wire preamble MUST be queued before the TLS drive below: a
-        # handshake that completes synchronously flushes the outbuf, and
-        # bytes appended afterwards would strand with no writer
-        self.outbuf = bytearray(preamble)
+        # handshake that completes synchronously flushes the send queue,
+        # and bytes appended afterwards would strand with no writer
+        self._out = wire.SendBuffer(knobs.TRANSPORT_COMPACT_WATERMARK)
+        if preamble:
+            self._out.append(preamble)
+        self.metrics.track_buffer(self._rb)
+        self.metrics.track_buffer(self._out)
+        self.metrics.connections.add(1)
+        # gen-7 frame batching: encoded messages collect here per tick and
+        # flush as ONE super-frame (knob off = per-message gen-6 framing)
+        self._batching = bool(knobs.TRANSPORT_FRAME_BATCHING)
+        self._batch_cap = max(int(knobs.TRANSPORT_MAX_BATCH_MESSAGES), 1)
+        self._pending_msgs: list[bytes] = []
         self.closed = False
         self.handshaken = peer is not None and False  # always expect preamble
         self._flush_scheduled = False
@@ -87,6 +115,13 @@ class _Conn:
 
         self._tls_handshaking = isinstance(sock, _ssl.SSLSocket)
         self._tls_write_wants_read = False
+        # vectored flush only on plain sockets (SSLSocket *exposes*
+        # sendmsg but raises NotImplementedError at call time)
+        self._sendmsg = (
+            None
+            if isinstance(sock, _ssl.SSLSocket)
+            else getattr(sock, "sendmsg", None)
+        )
         sock.setblocking(False)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -116,9 +151,9 @@ class _Conn:
             self.close()
             return
         self._tls_handshaking = False
-        if self.outbuf:
+        if len(self._out):
             self._on_writable()
-            if self.outbuf and not self.closed:
+            if len(self._out) and not self.closed:
                 self.world.loop.add_writer(self.sock, self._on_writable)
         # application bytes may have arrived WITH the handshake's last
         # flight and now sit decrypted inside the SSL object — the fd
@@ -130,27 +165,102 @@ class _Conn:
     def send(self, msg: Any) -> None:
         if self.closed:
             return
-        self.outbuf += wire.encode_frame(wire.encode_value(msg))
+        payload = wire.encode_value(msg)
+        m = self.metrics
+        m.messages_sent.add(1)
+        m.tcp_messages.add(1)
+        if self._batching:
+            self._pending_msgs.append(payload)
+            if len(self._pending_msgs) >= self._batch_cap:
+                self._emit()  # early flush; ordering preserved within the tick
+        else:
+            frame = wire.encode_frame(payload)
+            self._out.append(frame)
+            m.frames_sent.add(1)
+            m.bytes_sent.add(len(frame))
         # coalesced flush: every message queued during THIS loop tick goes
-        # out in one send() syscall (the flush runs at ZERO priority after
-        # all same-time work — profiling the real cluster put per-message
-        # syscalls at ~25% of client CPU). No select() wait intervenes, so
-        # latency is unchanged.
+        # out in one super-frame / one send() syscall (the flush runs at
+        # ZERO priority after all same-time work — profiling the real
+        # cluster put per-message syscalls at ~25% of client CPU). No
+        # select() wait intervenes, so latency is unchanged.
         if not self._flush_scheduled and not self._tls_handshaking:
             self._flush_scheduled = True
             self.world.loop.call_soon(self._flush_tick, TaskPriority.ZERO)
+
+    def _emit(self) -> None:
+        """Package this tick's coalesced messages into one wire frame."""
+        msgs = self._pending_msgs
+        if not msgs:
+            return
+        self._pending_msgs = []
+        m = self.metrics
+        m.frames_sent.add(1)
+        m.messages_per_flush.add(float(len(msgs)))
+        if len(msgs) == 1:
+            # a lone message rides the (smaller) gen-6 frame — both decode
+            # paths stay exercised on every connection
+            frame = wire.encode_frame(msgs[0])
+            m.bytes_sent.add(len(frame))
+            self._out.append(frame)
+            return
+        iov = wire.encode_super_frame(msgs)
+        nbytes = sum(len(b) for b in iov)
+        m.bytes_sent.add(nbytes)
+        fault = self.world._flush_fault
+        if fault is not None and fault(self):
+            # injected partial flush (the sim's transport-truncate analog
+            # for real sockets): half the super-frame hits the wire, then
+            # the connection dies — the peer must discard the torn frame
+            # and every in-flight request must fail typed, not hang
+            m.truncation_faults.add(1)
+            joined = b"".join(iov)
+            try:
+                self.sock.send(joined[: len(joined) // 2])
+            except OSError:
+                pass
+            self.close()
+            return
+        if (
+            not len(self._out)
+            and self._sendmsg is not None
+            and not self._tls_handshaking
+            and len(iov) <= 1000  # IOV_MAX headroom
+        ):
+            # vectored fast path: the whole super-frame leaves in one
+            # sendmsg with zero concatenation; a partial send spills the
+            # tail into the send queue and the writer picks it up
+            try:
+                sent = self._sendmsg(iov)
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+            except OSError:
+                self.close()
+                return
+            if sent >= nbytes:
+                return
+            for buf in iov:
+                if sent >= len(buf):
+                    sent -= len(buf)
+                    continue
+                self._out.append(buf[sent:] if sent else buf)
+                sent = 0
+            return
+        for buf in iov:
+            self._out.append(buf)
 
     def _flush_tick(self) -> None:
         self._flush_scheduled = False
         if self.closed or self._tls_handshaking:
             return
+        self._emit()
         # always attempt the flush and (re)arm the writer on leftover:
-        # assuming "non-empty outbuf implies a registered writer" once
+        # assuming "non-empty send queue implies a registered writer" once
         # stranded a preamble queued right after a synchronously-
         # completing TLS handshake
-        self._on_writable()
-        if self.outbuf and not self.closed:
-            self.world.loop.add_writer(self.sock, self._on_writable)
+        if len(self._out):
+            self._on_writable()
+            if len(self._out) and not self.closed:
+                self.world.loop.add_writer(self.sock, self._on_writable)
 
     def _on_writable(self) -> None:
         if self._tls_handshaking:
@@ -160,11 +270,11 @@ class _Conn:
         import ssl as _ssl
 
         try:
-            while self.outbuf:
-                n = self.sock.send(self.outbuf)
+            while len(self._out):
+                n = self.sock.send(self._out.peek())
                 if n <= 0:
                     break
-                del self.outbuf[:n]
+                self._out.consume(n)
         except _ssl.SSLWantReadError:
             # the SSL layer must READ (a post-handshake record) before
             # this write can proceed; keeping the writer armed would
@@ -178,7 +288,7 @@ class _Conn:
         except OSError:
             self.close()
             return
-        if not self.outbuf:
+        if not len(self._out):
             self.world.loop.remove_writer(self.sock)
 
     def _on_readable(self) -> None:
@@ -190,14 +300,15 @@ class _Conn:
             # a stalled write was waiting on inbound TLS records
             self._tls_write_wants_read = False
             self._on_writable()
-            if self.outbuf and not self.closed and not self._tls_write_wants_read:
+            if len(self._out) and not self.closed and not self._tls_write_wants_read:
                 self.world.loop.add_writer(self.sock, self._on_writable)
             if self.closed:
                 return
         import ssl as _ssl
 
+        rb = self._rb
         try:
-            data = self.sock.recv(1 << 16)
+            n = self.sock.recv_into(rb.writable(1 << 16))
         except (BlockingIOError, InterruptedError, _ssl.SSLWantReadError):
             return
         except (_ssl.SSLWantWriteError,):
@@ -206,34 +317,46 @@ class _Conn:
         except OSError:
             self.close()
             return
-        if not data:
+        if not n:
             self.close()
             return
-        self.inbuf += data
+        rb.commit(n)
+        self.metrics.bytes_received.add(n)
         # drain TLS-internal plaintext: decrypted bytes can sit in the SSL
         # buffer with no fd readiness to re-trigger select
         pending = getattr(self.sock, "pending", None)
         while pending is not None and pending():
             try:
-                more = self.sock.recv(1 << 16)
+                more = self.sock.recv_into(rb.writable(1 << 16))
             except (_ssl.SSLWantReadError, BlockingIOError):
                 break
             if not more:
                 break
-            self.inbuf += more
+            rb.commit(more)
+            self.metrics.bytes_received.add(more)
         try:
             if not self.handshaken:
-                hs = wire.parse_handshake(self.inbuf)
+                hs = wire.parse_handshake(rb.view())
                 if hs is None:
                     return
                 addr, consumed = hs
-                del self.inbuf[:consumed]
+                rb.consume(consumed)
                 self.handshaken = True
                 if self.peer is None:
                     self.peer = addr
                 self.world._conn_ready(self)
-            for payload in wire.decode_frames(self.inbuf):
-                self.world._on_message(self, wire.decode_value(payload))
+            views, consumed, n_frames = wire.parse_frames(rb)
+            # decode BEFORE consuming: the views alias buffer storage that
+            # consumption may compact
+            msgs = [wire.decode_value(v) for v in views]
+            del views
+            rb.consume(consumed)
+            if msgs:
+                m = self.metrics
+                m.frames_received.add(n_frames)
+                m.messages_received.add(len(msgs))
+                m.tcp_messages.add(len(msgs))
+                self.world._on_batch(self, msgs)
         except wire.WireError as e:
             trace(SevWarn, "WireError", self.world.node.address, Err=str(e))
             self.close()
@@ -242,6 +365,10 @@ class _Conn:
         if self.closed:
             return
         self.closed = True
+        self._pending_msgs.clear()
+        self.metrics.untrack_buffer(self._rb)
+        self.metrics.untrack_buffer(self._out)
+        self.metrics.connections_closed.add(1)
         self.world.loop.remove_reader(self.sock)
         self.world.loop.remove_writer(self.sock)
         try:
@@ -357,14 +484,28 @@ class RealWorld:
         # Sim-surface compatibility (Database, roles):
         self.processes = {listen_addr: self.node}
         self._disks: dict[str, Any] = {}
-        self._conns: dict[str, _Conn] = {}  # peer listen addr → live conn
+        self._conns: dict[str, Any] = {}  # peer listen addr → live conn
         self._connecting: dict[str, Future] = {}
         self._anon: list[_Conn] = []  # accepted, pre-handshake
         self._pending: dict[int, tuple[Future, str]] = {}  # id → (fut, peer)
+        self._inflight: dict[str, int] = {}  # peer → requests in flight
         self._disconnect_watchers: list[Callable[[str], None]] = []
         self._next_id = 1
         self._listener: Optional[socket.socket] = None
+        # transport counters (net/metrics.py): one collection per world,
+        # fed by every connection and the loopback path; the worker's
+        # transport.metrics endpoint and status `transport` section pull it
+        self.transport_metrics = TransportMetrics(listen_addr)
+        # test/chaos hook: callable(conn) -> bool deciding whether THIS
+        # flush is torn mid-super-frame (partial flush + connection death)
+        self._flush_fault: Optional[Callable[[_Conn], bool]] = None
+        # in-process loopback (net/loopback.py): colocated worlds on the
+        # same loop bypass sockets entirely. TLS worlds never loop back —
+        # their peer-authentication story must not be silently bypassed.
+        self._loopback_ok = bool(self.knobs.TRANSPORT_LOOPBACK) and tls is None
         self._listen()
+        self.transport_metrics.stats.id = self.node.address
+        loopback.register(self)
         # run-loop profiler, REAL personality: wall busy/starvation + the
         # SlowTask trace events. Installed after _listen so the ident is
         # the node's final address (ephemeral ports are adopted there);
@@ -409,6 +550,7 @@ class RealWorld:
         return fut.get()
 
     def close(self) -> None:
+        loopback.unregister(self)
         if self._listener is not None:
             self.loop.remove_reader(self._listener)
             self._listener.close()
@@ -465,7 +607,7 @@ class RealWorld:
             )
             if not conn._tls_handshaking and not conn.closed:
                 conn._on_writable()
-                if conn.outbuf and not conn.closed:
+                if len(conn._out) and not conn.closed:
                     self.loop.add_writer(sock, conn._on_writable)
             if not conn.closed:
                 self._anon.append(conn)
@@ -483,21 +625,21 @@ class RealWorld:
         if waiter is not None and not waiter.is_ready():
             waiter._set(None)
 
-    def _conn_closed(self, conn: _Conn) -> None:
+    def _conn_closed(self, conn) -> None:
         if conn in self._anon:
             self._anon.remove(conn)
         if conn.peer is not None and self._conns.get(conn.peer) is conn:
             del self._conns[conn.peer]
         # fail requests that were in flight on this connection
         dead = [
-            (rid, fut)
-            for rid, (fut, peer) in self._pending.items()
+            rid
+            for rid, (_fut, peer) in self._pending.items()
             if peer == conn.peer
         ]
-        for rid, fut in dead:
-            self._pending.pop(rid, None)
-            if not fut.is_ready():
-                fut._set_error(BrokenPromise(f"connection to {conn.peer} lost"))
+        for rid in dead:
+            ent = self._pending_pop(rid)
+            if ent is not None and not ent[0].is_ready():
+                ent[0]._set_error(BrokenPromise(f"connection to {conn.peer} lost"))
         waiter = self._connecting.pop(conn.peer, None) if conn.peer else None
         if waiter is not None and not waiter.is_ready():
             waiter._set_error(BrokenPromise(f"connect to {conn.peer} failed"))
@@ -592,7 +734,7 @@ class RealWorld:
                 return
             try:
                 conn._on_writable()
-                if conn.outbuf:
+                if len(conn._out):
                     self.loop.add_writer(sock, conn._on_writable)
             except OSError:
                 conn.close()
@@ -601,6 +743,21 @@ class RealWorld:
         return waiter
 
     # -- RPC -------------------------------------------------------------------
+
+    def _pending_add(self, rid: int, fut: Future, peer: str) -> None:
+        self._pending[rid] = (fut, peer)
+        self._inflight[peer] = self._inflight.get(peer, 0) + 1
+
+    def _pending_pop(self, rid: int):
+        ent = self._pending.pop(rid, None)
+        if ent is not None:
+            peer = ent[1]
+            left = self._inflight.get(peer, 0) - 1
+            if left > 0:
+                self._inflight[peer] = left
+            else:
+                self._inflight.pop(peer, None)
+        return ent
 
     def request(self, ep: Endpoint, payload: Any) -> Future:
         from ..runtime import trace as _trace
@@ -616,8 +773,23 @@ class RealWorld:
         # child of the caller's span without the payload knowing
         msg = ("req", rid, ep.token, payload, wire.pack_span_context(_trace.active_span()))
         conn = self._conns.get(ep.address)
+        if conn is None and self._loopback_ok:
+            target = loopback.lookup(ep.address)
+            if (
+                target is not None
+                and target is not self
+                and getattr(target, "_loopback_ok", False)
+                and target.loop is self.loop
+                and target._listener is not None
+            ):
+                conn = loopback.connect(self, target)
         if conn is not None:
-            self._pending[rid] = (reply, ep.address)
+            # connection-level pipelining: requests never wait for replies;
+            # the depth sample is the in-flight count this one joined
+            self.transport_metrics.pipelined_depth.add(
+                float(self._inflight.get(ep.address, 0))
+            )
+            self._pending_add(rid, reply, ep.address)
             conn.send(msg)
             return reply
 
@@ -633,7 +805,7 @@ class RealWorld:
                 if not reply.is_ready():
                     reply._set_error(BrokenPromise(f"no route to {ep.address}"))
                 return
-            self._pending[rid] = (reply, ep.address)
+            self._pending_add(rid, reply, ep.address)
             c.send(msg)
 
         waiter.add_callback(lambda _f: on_conn())
@@ -664,72 +836,86 @@ class RealWorld:
             run_and_reply(), name=getattr(handler, "__qualname__", None)
         )
 
-    def _on_message(self, conn: _Conn, msg) -> None:
-        kind = msg[0]
-        if kind == "req":
-            _k, rid, token, payload, *rest = msg
-            handler = self.node.endpoints.get(token)
-            if handler is None:
-                conn.send(("err", rid, "broken_promise", token))
+    async def _run_and_reply(self, conn, rid: int, token: str, handler, payload):
+        try:
+            result = await handler(payload)
+        except Cancelled:
+            conn.send(("err", rid, "broken_promise", token))
+            return
+        except FdbError as e:
+            conn.send(("err", rid, "fdb", type(e).__name__))
+            return
+        except BrokenPromise as e:
+            conn.send(("err", rid, "broken_promise", str(e)))
+            return
+        except BaseException as e:
+            if type(e).__name__ in _named_errors():
+                conn.send(("err", rid, "named", (type(e).__name__, str(e))))
                 return
-            span_ctx = wire.unpack_span_context(rest[0]) if rest else None
+            conn.send(("err", rid, "remote", repr(e)))
+            return
+        conn.send(("ok", rid, result))
 
-            async def run_and_reply(rid=rid, handler=handler, payload=payload):
-                try:
-                    result = await handler(payload)
-                except Cancelled:
+    def _on_batch(self, conn, msgs: list) -> None:
+        """Batch dispatch for one inbound frame (or loopback drain):
+        replies resolve inline; the frame's REQUESTS all start in a single
+        loop step (futures.start_batch) — N handler wakeups collapse into
+        one, which is where the per-request wakeup tax went (run-loop
+        profiler evidence, ISSUE 14)."""
+        from ..runtime import trace as _trace
+
+        tasks: list[Task] = []
+        for msg in msgs:
+            kind = msg[0]
+            if kind == "req":
+                _k, rid, token, payload, *rest = msg
+                handler = self.node.endpoints.get(token)
+                if handler is None:
                     conn.send(("err", rid, "broken_promise", token))
-                    return
-                except FdbError as e:
-                    conn.send(("err", rid, "fdb", type(e).__name__))
-                    return
-                except BrokenPromise as e:
-                    conn.send(("err", rid, "broken_promise", str(e)))
-                    return
-                except BaseException as e:
-                    if type(e).__name__ in _named_errors():
-                        conn.send(
-                            ("err", rid, "named", (type(e).__name__, str(e)))
-                        )
-                        return
-                    conn.send(("err", rid, "remote", repr(e)))
-                    return
-                conn.send(("ok", rid, result))
-
-            from ..runtime import trace as _trace
-
-            prev = _trace.swap_active_span(span_ctx)
-            try:
-                # profiler attribution names the handler, not the shim
-                self.node.spawn(
-                    run_and_reply(), name=getattr(handler, "__qualname__", None)
-                )
-            finally:
-                _trace.swap_active_span(prev)
-        elif kind == "ok":
-            _k, rid, value = msg
-            ent = self._pending.pop(rid, None)
-            if ent is not None and not ent[0].is_ready():
-                ent[0]._set(value)
-        elif kind == "err":
-            _k, rid, etype, detail = msg
-            ent = self._pending.pop(rid, None)
-            if ent is None or ent[0].is_ready():
-                return
-            if etype == "fdb":
-                from .. import errors as _errors
-
-                cls = getattr(_errors, str(detail), FdbError)
-                if not (isinstance(cls, type) and issubclass(cls, FdbError)):
-                    cls = FdbError
-                ent[0]._set_error(cls(str(detail)))
-            elif etype == "broken_promise":
-                ent[0]._set_error(BrokenPromise(str(detail)))
-            elif etype == "named":
-                name, text = detail
-                cls = _named_errors().get(str(name), RemoteError)
-                ent[0]._set_error(cls(str(text)))
+                    continue
+                span_ctx = wire.unpack_span_context(rest[0]) if rest else None
+                prev = _trace.swap_active_span(span_ctx)
+                try:
+                    # profiler attribution names the handler, not the shim
+                    t = Task(
+                        self._run_and_reply(conn, rid, token, handler, payload),
+                        name=getattr(handler, "__qualname__", None),
+                    )
+                finally:
+                    _trace.swap_active_span(prev)
+                self.node.actors.add(t.future)
+                tasks.append(t)
+            elif kind == "ok":
+                _k, rid, value = msg
+                ent = self._pending_pop(rid)
+                if ent is not None and not ent[0].is_ready():
+                    ent[0]._set(value)
+            elif kind == "err":
+                self._on_reply_err(msg)
             else:
-                ent[0]._set_error(RemoteError(str(detail)))
+                trace(SevWarn, "WireBadKind", self.node.address, Kind=str(kind))
+        start_batch(tasks)
+
+    def _on_message(self, conn, msg) -> None:
+        self._on_batch(conn, [msg])
+
+    def _on_reply_err(self, msg) -> None:
+        _k, rid, etype, detail = msg
+        ent = self._pending_pop(rid)
+        if ent is None or ent[0].is_ready():
+            return
+        if etype == "fdb":
+            from .. import errors as _errors
+
+            cls = getattr(_errors, str(detail), FdbError)
+            if not (isinstance(cls, type) and issubclass(cls, FdbError)):
+                cls = FdbError
+            ent[0]._set_error(cls(str(detail)))
+        elif etype == "broken_promise":
+            ent[0]._set_error(BrokenPromise(str(detail)))
+        elif etype == "named":
+            name, text = detail
+            cls = _named_errors().get(str(name), RemoteError)
+            ent[0]._set_error(cls(str(text)))
         else:
-            trace(SevWarn, "WireBadKind", self.node.address, Kind=str(kind))
+            ent[0]._set_error(RemoteError(str(detail)))

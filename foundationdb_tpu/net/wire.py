@@ -120,8 +120,22 @@ def _enc_bytes(out, v):
     out.append(bytes(v))
 
 
+# memoized whole encodings for short, recurring strings: message kinds
+# ("req"/"ok"/"err"), endpoint tokens, and role uids repeat on every RPC —
+# bounded cache so adversarial/unbounded string sets cannot grow it
+_STR_CACHE: dict = {}
+
+
 def _enc_str_v(out, v):
+    enc = _STR_CACHE.get(v)
+    if enc is not None:
+        out.append(enc)
+        return
     b = v.encode()
+    if len(b) <= 64 and len(_STR_CACHE) < 4096:
+        _STR_CACHE[v] = enc = _B_STR + _U32(len(b)) + b
+        out.append(enc)
+        return
     out.append(_B_STR)
     out.append(_U32(len(b)))
     out.append(b)
@@ -276,32 +290,83 @@ def _enc_str(out: list, s: str) -> None:
 
 
 class _Reader:
-    __slots__ = ("buf", "pos")
+    __slots__ = ("buf", "pos", "_mv")
 
-    def __init__(self, buf: bytes):
+    def __init__(self, buf):
+        # memoryview input = zero-copy decode straight out of the receive
+        # ring (net/tcp.py): only leaf byte values are materialized (they
+        # must own their bytes — decoded messages outlive the buffer)
         self.buf = buf
         self.pos = 0
+        self._mv = isinstance(buf, memoryview)
 
     def take(self, n: int) -> bytes:
         v = self.buf[self.pos : self.pos + n]
         if len(v) != n:
             raise WireError("truncated message")
         self.pos += n
-        return v
+        return bytes(v) if self._mv else v
 
     def u8(self) -> int:
-        return self.take(1)[0]
+        v = self.buf[self.pos]
+        self.pos += 1
+        return v
 
     def u16(self) -> int:
-        return struct.unpack("<H", self.take(2))[0]
+        v = struct.unpack_from("<H", self.buf, self.pos)[0]
+        self.pos += 2
+        return v
 
     def u32(self) -> int:
-        return struct.unpack("<I", self.take(4))[0]
+        v = struct.unpack_from("<I", self.buf, self.pos)[0]
+        self.pos += 4
+        return v
+
+
+_U32_UNPACK_FROM = struct.Struct("<I").unpack_from
+_F64_UNPACK_FROM = struct.Struct("<d").unpack_from
 
 
 def _dec_int(r):
-    n = r.u8()
-    return int.from_bytes(r.take(n), "little", signed=True)
+    # direct-slice read (no take() call / no leaf copy): int.from_bytes
+    # accepts memoryview slices; frames are CRC-verified before decode,
+    # and decode_value's end-position check catches overruns
+    buf = r.buf
+    pos = r.pos
+    n = buf[pos]
+    end = pos + 1 + n
+    r.pos = end
+    return int.from_bytes(buf[pos + 1 : end], "little", signed=True)
+
+
+def _dec_float(r):
+    v = _F64_UNPACK_FROM(r.buf, r.pos)[0]
+    r.pos += 8
+    return v
+
+
+def _dec_bytes(r):
+    buf = r.buf
+    pos = r.pos
+    (n,) = _U32_UNPACK_FROM(buf, pos)
+    end = pos + 4 + n
+    v = buf[pos + 4 : end]
+    if len(v) != n:
+        raise WireError("truncated message")
+    r.pos = end
+    return bytes(v) if r._mv else v
+
+
+def _dec_str(r):
+    buf = r.buf
+    pos = r.pos
+    (n,) = _U32_UNPACK_FROM(buf, pos)
+    end = pos + 4 + n
+    v = buf[pos + 4 : end]
+    if len(v) != n:
+        raise WireError("truncated message")
+    r.pos = end
+    return str(v, "utf-8")
 
 
 def _dec_enum(r):
@@ -330,21 +395,24 @@ _DEC_DISPATCH = [
     lambda r: True,  # _TRUE
     lambda r: False,  # _FALSE
     _dec_int,  # _INT
-    lambda r: struct.unpack("<d", r.take(8))[0],  # _FLOAT
-    lambda r: r.take(r.u32()),  # _BYTES
-    lambda r: r.take(r.u32()).decode(),  # _STR
-    lambda r: tuple(_dec(r) for _ in range(r.u32())),  # _TUPLE
+    _dec_float,  # _FLOAT
+    _dec_bytes,  # _BYTES
+    _dec_str,  # _STR
+    lambda r: tuple([_dec(r) for _ in range(r.u32())]),  # _TUPLE
     lambda r: [_dec(r) for _ in range(r.u32())],  # _LIST
     lambda r: {_dec(r): _dec(r) for _ in range(r.u32())},  # _DICT
     lambda r: {_dec(r) for _ in range(r.u32())},  # _SET
-    lambda r: frozenset(_dec(r) for _ in range(r.u32())),  # _FROZENSET
+    lambda r: frozenset([_dec(r) for _ in range(r.u32())]),  # _FROZENSET
     _dec_struct,  # _STRUCT
     _dec_enum,  # _ENUM
 ]
 
 
 def _dec(r: _Reader):
-    tag = r.u8()
+    buf = r.buf
+    pos = r.pos
+    tag = buf[pos]
+    r.pos = pos + 1
     if tag >= len(_DEC_DISPATCH):
         raise WireError(f"bad tag {tag}")
     return _DEC_DISPATCH[tag](r)
@@ -356,19 +424,62 @@ def encode_value(v) -> bytes:
     return b"".join(out)
 
 
-def decode_value(buf: bytes):
+def decode_value(buf):
     r = _Reader(buf)
-    v = _dec(r)
+    try:
+        v = _dec(r)
+    except (IndexError, struct.error):
+        # direct-slice readers surface truncation as index/struct errors;
+        # normalize so connections drop with WireError like any bad frame
+        raise WireError("truncated message")
     if r.pos != len(buf):
         raise WireError("trailing bytes in message")
     return v
 
 
 # -- frames --------------------------------------------------------------------
+#
+# Two wire framings share the stream (gen-7):
+#
+#   legacy frame      [u32 length][u32 crc32][payload]
+#   super-frame       [u32 entries_len | BATCH_BIT][u32 crc32][u32 count]
+#                     then count x ([u32 len][payload])
+#
+# The high bit of the length word marks a super-frame (legacy lengths are
+# capped at 2^30, so the bit is unambiguous). A super-frame carries every
+# message a connection coalesced in one loop tick — ONE frame header, ONE
+# checksum, ONE receive-side dispatch for the whole batch. The CRC covers
+# the entries region. Receivers accept both framings unconditionally;
+# the TRANSPORT_FRAME_BATCHING knob only selects what a sender EMITS, so
+# the gen-6-shaped path stays available for A/B within one build.
+
+_BATCH_BIT = 0x8000_0000
+_SUPER = struct.Struct("<III")  # entries_len|BATCH_BIT, crc32, count
+_U32_AT = struct.Struct("<I").unpack_from
 
 
 def encode_frame(payload: bytes) -> bytes:
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def encode_super_frame(payloads: list) -> list:
+    """One super-frame as an iovec-style buffer list
+    (``[header, len0, p0, len1, p1, ...]``) — built for vectored
+    ``socket.sendmsg`` flushes, so the coalesced messages are never
+    copied into a joined buffer on the fast path."""
+    iov = [b""]
+    elen = 0
+    crc = 0
+    for p in payloads:
+        pfx = _U32(len(p))
+        crc = zlib.crc32(p, zlib.crc32(pfx, crc))
+        iov.append(pfx)
+        iov.append(p)
+        elen += 4 + len(p)
+    if elen >= 1 << 30:
+        raise WireError(f"oversized super-frame {elen}")
+    iov[0] = _SUPER.pack(elen | _BATCH_BIT, crc, len(payloads))
+    return iov
 
 
 def decode_frames(buf: bytearray):
@@ -389,6 +500,160 @@ def decode_frames(buf: bytearray):
         pos += _FRAME.size + length
     del buf[:pos]
     return out
+
+
+def parse_frames(rb: "RecvBuffer"):
+    """Parse complete frames (legacy AND super) out of a receive buffer.
+    Returns ``(payload_views, consumed, n_frames)`` where the views point
+    INTO the buffer — decode them before calling ``rb.consume(consumed)``
+    (consumption may compact the underlying storage)."""
+    out = []
+    frames = 0
+    mv = rb.view()
+    n = len(mv)
+    pos = 0
+    while n - pos >= _FRAME.size:
+        length, crc = _FRAME.unpack_from(mv, pos)
+        if length & _BATCH_BIT:
+            elen = length & ~_BATCH_BIT
+            if elen >= 1 << 30:
+                raise WireError(f"oversized super-frame {elen}")
+            if n - pos < _SUPER.size or n - pos - _SUPER.size < elen:
+                break  # incomplete — wait for more bytes
+            (count,) = _U32_AT(mv, pos + 8)
+            entries = mv[pos + _SUPER.size : pos + _SUPER.size + elen]
+            if zlib.crc32(entries) != crc:
+                raise WireError("super-frame checksum mismatch")
+            epos = 0
+            for _ in range(count):
+                if elen - epos < 4:
+                    raise WireError("super-frame entry truncated")
+                (plen,) = _U32_AT(entries, epos)
+                if elen - epos - 4 < plen:
+                    raise WireError("super-frame entry truncated")
+                out.append(entries[epos + 4 : epos + 4 + plen])
+                epos += 4 + plen
+            if epos != elen:
+                raise WireError("trailing bytes in super-frame")
+            frames += 1
+            pos += _SUPER.size + elen
+        else:
+            if length > 1 << 30:
+                raise WireError(f"oversized frame {length}")
+            if n - pos - _FRAME.size < length:
+                break
+            payload = mv[pos + _FRAME.size : pos + _FRAME.size + length]
+            if zlib.crc32(payload) != crc:
+                raise WireError("frame checksum mismatch")
+            out.append(payload)
+            frames += 1
+            pos += _FRAME.size + length
+    return out, pos, frames
+
+
+# -- transport buffers ---------------------------------------------------------
+
+
+class RecvBuffer:
+    """Preallocated receive buffer: ``recv_into`` lands bytes directly in
+    place, frames are parsed as zero-copy ``memoryview`` slices, and
+    consumed space is reclaimed by watermark-triggered compaction — total
+    copying over a connection's life is O(bytes received), not the
+    O(n²) of per-message ``bytes +=`` / ``del buf[:n]`` churn.
+    ``bytes_moved`` counts every byte compaction relocates (the
+    regression test's accounting)."""
+
+    __slots__ = ("_buf", "_pos", "_end", "watermark", "bytes_moved")
+
+    def __init__(self, size: int = 1 << 16, watermark: int = 1 << 16):
+        self._buf = bytearray(max(int(size), 4096))
+        self._pos = 0  # consumed offset
+        self._end = 0  # filled offset
+        self.watermark = max(int(watermark), 1)
+        self.bytes_moved = 0
+
+    def __len__(self) -> int:
+        return self._end - self._pos
+
+    def writable(self, need: int = 1 << 16) -> memoryview:
+        """A view of free tail space (at least ``need`` bytes): compacts
+        first if the live region is offset, grows (doubles) only when the
+        live bytes genuinely exceed capacity."""
+        if len(self._buf) - self._end < need:
+            if self._pos:
+                self._compact()
+            while len(self._buf) - self._end < need:
+                self._buf.extend(bytes(len(self._buf)))
+        return memoryview(self._buf)[self._end :]
+
+    def commit(self, n: int) -> None:
+        """Bytes were written into ``writable()`` space."""
+        self._end += n
+
+    def feed(self, data) -> None:
+        """Copy-in path for tests and non-socket sources."""
+        mv = self.writable(len(data))
+        mv[: len(data)] = data
+        del mv  # release the export before any later resize
+        self._end += len(data)
+
+    def view(self) -> memoryview:
+        return memoryview(self._buf)[self._pos : self._end]
+
+    def consume(self, n: int) -> None:
+        self._pos += n
+        if self._pos == self._end:
+            self._pos = self._end = 0  # free reset — nothing to move
+        elif self._pos >= self.watermark and self._pos >= self._end - self._pos:
+            # compact only once the dead prefix outweighs the live bytes:
+            # each surviving byte can then be moved O(1) times amortized
+            # (dead-prefix-only watermarks re-move a large live tail per
+            # small consume — the quadratic shape this class exists to kill)
+            self._compact()
+
+    def _compact(self) -> None:
+        live = self._end - self._pos
+        self._buf[0:live] = self._buf[self._pos : self._end]
+        self.bytes_moved += live
+        self._pos, self._end = 0, live
+
+
+class SendBuffer:
+    """Outbound byte queue with a consumed offset instead of per-send
+    ``del buf[:n]``: partial sends advance the offset (O(1)), and the dead
+    prefix is reclaimed in one move once it crosses the watermark —
+    amortized O(1) per byte regardless of how the kernel fragments the
+    sends. ``bytes_moved`` accounts compaction work."""
+
+    __slots__ = ("_buf", "_pos", "watermark", "bytes_moved")
+
+    def __init__(self, watermark: int = 1 << 16):
+        self._buf = bytearray()
+        self._pos = 0
+        self.watermark = max(int(watermark), 1)
+        self.bytes_moved = 0
+
+    def __len__(self) -> int:
+        return len(self._buf) - self._pos
+
+    def append(self, data) -> None:
+        self._buf += data
+
+    def peek(self) -> memoryview:
+        return memoryview(self._buf)[self._pos :]
+
+    def consume(self, n: int) -> None:
+        self._pos += n
+        live = len(self._buf) - self._pos
+        if not live:
+            self._buf.clear()
+            self._pos = 0
+        elif self._pos >= self.watermark and self._pos >= live:
+            # same amortization rule as RecvBuffer: reclaim only when the
+            # dead prefix outweighs the live bytes
+            del self._buf[: self._pos]
+            self.bytes_moved += live
+            self._pos = 0
 
 
 def pack_span_context(ctx) -> Optional[tuple]:

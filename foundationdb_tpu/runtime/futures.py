@@ -216,6 +216,26 @@ def spawn(coro, priority: int = TaskPriority.DEFAULT, name: str = None) -> Futur
     return Task(coro, priority, name).start()
 
 
+def start_batch(tasks: list) -> None:
+    """Start many NOT-yet-started Tasks with ONE loop queue entry (the
+    transport's server-side batch dispatch, net/tcp.py): a super-frame of
+    N requests drains in a single loop step instead of scheduling N
+    wakeups. Each task's first step still runs under its own profiler
+    attribution (loop.call_soon_batch); subsequent steps reschedule
+    individually as usual. A task cancelled before the batch runs resolves
+    through the normal MAX-priority Cancelled re-throw — its batch step
+    then no-ops on the ready future."""
+    if not tasks:
+        return
+    if len(tasks) == 1:
+        tasks[0].start()
+        return
+    tasks[0].loop.call_soon_batch(
+        [((lambda t=t: t._step(None, None)), t.name) for t in tasks],
+        tasks[0].priority,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Timers / yields
 

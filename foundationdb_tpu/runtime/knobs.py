@@ -167,6 +167,20 @@ class Knobs:
     # stop-and-wait: batch N+1 dispatches while batch N's reply is on
     # the wire)
     CLIENT_READ_PIPELINE_DEPTH = 4
+    # transport v2 (ISSUE 14 / ROADMAP item 6): frame-batched zero-copy
+    # wire path. Batching selects what a sender EMITS (gen-7 super-frames
+    # vs gen-6 per-message frames — receivers accept both, so the A/B
+    # runs within one build); loopback short-circuits colocated worlds in
+    # the same OS process onto an in-process byte path (net/loopback.py)
+    TRANSPORT_FRAME_BATCHING = True
+    TRANSPORT_LOOPBACK = True
+    TRANSPORT_RECV_BYTES = 1 << 16  # preallocated recv buffer (grows on demand)
+    TRANSPORT_COMPACT_WATERMARK = 1 << 16  # consumed bytes before compaction
+    TRANSPORT_MAX_BATCH_MESSAGES = 512  # messages per super-frame before early flush
+    # sim-only transport chaos: super-frame truncation / partial-flush
+    # site — a faulted request's caller sees a typed retryable error
+    # (TransportTruncated), never a wedged connection
+    TRANSPORT_FAULT_INJECTION = False
     # simulation (Sim2's latency model: MIN + FAST·a almost always, rare
     # tail to MAX — flow/Knobs.cpp:106-108, sim2.actor.cpp:1618)
     SIM_MIN_LATENCY = 0.0001
@@ -308,6 +322,28 @@ class Knobs:
             self.RK_BATCH_SENSITIVITY = rng.random_choice([0.25, 0.5, 0.75])
         if rng.coinflip(0.25):
             self.RK_ADMISSION_TICK = rng.random_choice([0.005, 0.02, 0.05])
+
+    def randomize_transport(self, rng) -> None:
+        """Transport-knob randomization (ISSUE 14), drawn at the very END
+        of the soak's sequence (after randomize_admission) for the same
+        pinned-seed reason as the read-pipeline/admission draws: the
+        earlier cluster-shape and workload-rotation draws must reproduce
+        exactly. Arming TRANSPORT_FAULT_INJECTION makes the soak call
+        ``sim.arm_transport_faults`` with a DEDICATED forked rng, so even
+        the armed runs leave the main chaos stream untouched."""
+        if rng.coinflip(0.25):
+            # both framings stay exercised across the soak matrix
+            self.TRANSPORT_FRAME_BATCHING = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            # tiny caps force the early-flush path
+            self.TRANSPORT_MAX_BATCH_MESSAGES = rng.random_choice([2, 64, 512])
+        if rng.coinflip(0.25):
+            # tiny watermarks force constant compaction
+            self.TRANSPORT_COMPACT_WATERMARK = rng.random_choice(
+                [1 << 12, 1 << 16]
+            )
+        if rng.coinflip(0.3):
+            self.TRANSPORT_FAULT_INJECTION = True
 
     def randomize_read_pipeline(self, rng) -> None:
         """Read-pipeline knob randomization, kept OUT of randomize():

@@ -22,7 +22,11 @@ import struct
 #        (distributed tracing; net/tcp.py "req" messages)
 # gen 5: batched read pipeline — storage.multiGet / storage.multiGetRange
 #        endpoints and their MultiGet*Request/Reply shapes (ISSUE 12)
-PROTOCOL_VERSION = 0x0FDB00B070010006  # gen-6: GRV priority/tenant envelope
+# gen 6: GRV priority/tenant envelope
+PROTOCOL_VERSION = 0x0FDB00B070010007  # gen-7: super-frame batched framing
+#        (net/wire.py BATCH_BIT frames; receivers accept gen-6-shaped
+#        per-message frames too, but a gen-6 build must not peer with a
+#        gen-7 one — the handshake rejects the mix)
 
 
 class BinaryWriter:

@@ -485,7 +485,8 @@ class ClusterController:
                 pull_one(address, "worker.systemMetrics")
             )
             pf = self.process.spawn(pull_one(address, "process.metrics"))
-            return address, await mf, await sf, await pf
+            tf = self.process.spawn(pull_one(address, "transport.metrics"))
+            return address, await mf, await sf, await pf, await tf
 
         from ..runtime.futures import wait_for_all
 
@@ -500,15 +501,51 @@ class ClusterController:
         # one loop they all share)
         processes = {}
         run_loop = {}
-        for address, metrics, sysm, proc in pulls:
+        transport = {}
+        for address, metrics, sysm, proc, tm in pulls:
             if metrics:
                 workers[address]["metrics"] = metrics
             if sysm:
                 processes[address] = sysm
             if proc:
                 run_loop[address] = proc
+            if tm:
+                transport[address] = tm
         doc["processes"] = processes
         doc["run_loop"] = run_loop
+        # transport section (ISSUE 14): per-process counter snapshots plus
+        # a cluster total — messages vs frames is the super-frame
+        # coalescing ratio, loopback vs tcp the colocated-path split.
+        # Sim processes share ONE world: dedupe identical snapshots by the
+        # collection ident before summing (same move as run_loop loop_id)
+        total = {
+            k: 0
+            for k in (
+                "messagesSent",
+                "messagesReceived",
+                "framesSent",
+                "framesReceived",
+                "bytesSent",
+                "bytesReceived",
+                "loopbackMessages",
+                "tcpMessages",
+                "truncationFaults",
+            )
+        }
+        seen_worlds = set()
+        for snap in transport.values():
+            ident = snap.get("id") or id(snap)
+            if ident in seen_worlds:
+                continue
+            seen_worlds.add(ident)
+            for k in total:
+                total[k] += snap.get(k) or 0
+        total["messagesPerFrame"] = (
+            round(total["messagesSent"] / total["framesSent"], 2)
+            if total["framesSent"]
+            else 0.0
+        )
+        doc["transport"] = {"processes": transport, "total": total}
         machines: dict = {}
         for address, sysm in processes.items():
             mkey = workers[address].get("machine") or address

@@ -127,6 +127,38 @@ async def settle_bounded(futs: list, seconds: float) -> list[bool]:
     return ok
 
 
+async def retransmitting_request(
+    process, ep, req, attempts: int = 5, backoff: float = 0.05
+):
+    """A commit-pipeline RPC with bounded retransmission on transport
+    loss. Resolve and tlog-commit requests are version-chained: a LOST
+    request tears a hole in the prev→version chain that wedges every
+    successor at the receiver's VersionGate forever, and a lost REPLY
+    just needs the duplicate answered — both receivers were built for
+    retransmits (the resolver caches replies by version,
+    Resolver.actor.cpp:159's outstandingBatches analog; the tlog acks
+    duplicate versions as already-durable), but nothing ever actually
+    retransmitted until the transport-truncate chaos site (ISSUE 14)
+    wedged the pipeline through exactly this gap. Typed epoch-end errors
+    (TLogStopped) propagate immediately; only the BrokenPromise family
+    (transport loss, incl. TransportTruncated) retransmits."""
+    from ..net.sim import BrokenPromise
+    from ..runtime.futures import delay
+    from ..runtime.loop import Cancelled
+
+    last = None
+    for attempt in range(attempts):
+        if attempt:
+            await delay(backoff * (1 << (attempt - 1)))
+        try:
+            return await process.request(ep, req)
+        except Cancelled:
+            raise  # actor-cancelled-swallow
+        except BrokenPromise as e:
+            last = e
+    raise last
+
+
 class LogSystem:
     """The proxy's handle on the current tlog generation (ILogSystem::push)."""
 
@@ -137,7 +169,10 @@ class LogSystem:
         self, process, prev_version, version, to_log: dict, known_committed: int = 0
     ) -> None:
         """Push one commit batch; resolves when durable on every tlog
-        (the push quorum — all replicas of every tag, see module doc)."""
+        (the push quorum — all replicas of every tag, see module doc).
+        Individual pushes retransmit on transport loss: a push abandoned
+        mid-epoch would leave a version hole that wedges the tlog's
+        commit chain (duplicates are acked as already-durable)."""
         if buggify():
             from ..runtime.futures import delay
 
@@ -154,15 +189,18 @@ class LogSystem:
                 t: ms for t, ms in to_log.items() if t in log.tags or t == TXS_TAG
             }
             pushes.append(
-                process.request(
-                    log.ep("commit"),
-                    TLogCommitRequest(
-                        epoch=self.tlog_set.epoch,
-                        prev_version=prev_version,
-                        version=version,
-                        messages=msgs,
-                        known_committed=known_committed,
-                    ),
+                process.spawn(
+                    retransmitting_request(
+                        process,
+                        log.ep("commit"),
+                        TLogCommitRequest(
+                            epoch=self.tlog_set.epoch,
+                            prev_version=prev_version,
+                            version=version,
+                            messages=msgs,
+                            known_committed=known_committed,
+                        ),
+                    )
                 )
             )
         await wait_for_all(pushes)

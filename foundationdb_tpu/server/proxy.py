@@ -91,7 +91,7 @@ from .interfaces import (
     TransactionData,
     Version,
 )
-from .log_system import LogSystem
+from .log_system import LogSystem, retransmitting_request
 from .tlog import TLogStopped
 from ..runtime.buggify import buggify
 
@@ -635,19 +635,25 @@ class Proxy:
             # beyond what phase 3 actually applied (an overclaim lets the
             # resolver retire state txns another in-flight reply needs)
             lrv = min(self._state_applied, vreq.prev_version)
+            # retransmitting (like every version-chained send): a plug
+            # whose resolve is lost would leave the very hole it exists
+            # to fill
             futs = [
-                self.process.request(
-                    iface.ep("resolve"),
-                    ResolveBatchRequest(
-                        prev_version=vreq.prev_version,
-                        version=vreq.version,
-                        last_receive_version=lrv,
-                        requesting_proxy=(
-                            f"{self.process.address}#{self.uid}"
+                self.process.spawn(
+                    retransmitting_request(
+                        self.process,
+                        iface.ep("resolve"),
+                        ResolveBatchRequest(
+                            prev_version=vreq.prev_version,
+                            version=vreq.version,
+                            last_receive_version=lrv,
+                            requesting_proxy=(
+                                f"{self.process.address}#{self.uid}"
+                            ),
+                            transactions=[],
+                            state_txn_indices=[],
                         ),
-                        transactions=[],
-                        state_txn_indices=[],
-                    ),
+                    )
                 )
                 for iface in self._all_resolvers
             ]
@@ -986,17 +992,24 @@ class Proxy:
         for iface, idxs, datas, state_idxs in resolvers:
             # every resolver sees every version to keep its chain advancing,
             # even with no transactions for it (Resolver.actor.cpp:104-122)
+            # retransmitting: a lost resolve tears a hole in the
+            # resolver's prev→version chain (wedging every later batch);
+            # the resolver caches replies by version precisely so a
+            # retransmit of a delivered-but-unanswered batch is safe
             reqs.append(
-                self.process.request(
-                    iface.ep("resolve"),
-                    ResolveBatchRequest(
-                        prev_version=prev_version,
-                        version=version,
-                        last_receive_version=self.last_resolver_versions,
-                        requesting_proxy=f"{self.process.address}#{self.uid}",
-                        transactions=datas,
-                        state_txn_indices=state_idxs,
-                    ),
+                self.process.spawn(
+                    retransmitting_request(
+                        self.process,
+                        iface.ep("resolve"),
+                        ResolveBatchRequest(
+                            prev_version=prev_version,
+                            version=version,
+                            last_receive_version=self.last_resolver_versions,
+                            requesting_proxy=f"{self.process.address}#{self.uid}",
+                            transactions=datas,
+                            state_txn_indices=state_idxs,
+                        ),
+                    )
                 )
             )
             meta.append(idxs)

@@ -81,6 +81,7 @@ class Worker:
         p.register("worker.metrics", self._role_metrics)
         p.register("worker.systemMetrics", self._system_metrics)
         p.register("process.metrics", self._process_metrics)
+        p.register("transport.metrics", self._transport_metrics)
         from ..runtime.loop import current_loop
         from ..runtime.monitor import system_monitor
 
@@ -151,6 +152,14 @@ class Worker:
 
         prof = getattr(current_loop(), "profiler", None)
         return prof.snapshot() if prof is not None else {}
+
+    async def _transport_metrics(self, _req) -> dict:
+        """This process's transport counters (net/metrics.py): messages vs
+        frames (the super-frame coalescing ratio), loopback/tcp split,
+        buffer compaction — the status document's `transport` section and
+        the `cli status` Transport line."""
+        tm = getattr(self.process.sim, "transport_metrics", None)
+        return tm.snapshot() if tm is not None else {}
 
     async def _system_metrics(self, _req) -> dict:
         """The SystemMonitor's latest ProcessMetrics sample (status's

@@ -150,6 +150,21 @@ class FdbCli:
                 f"index {idx_r.get('counter', 0)} / "
                 f"fallback {idx_f.get('counter', 0)})"
             )
+        tr = (doc.get("transport") or {}).get("total") or {}
+        if tr.get("messagesSent"):
+            lines.append(
+                f"Transport: {tr.get('messagesSent', 0)} msgs in "
+                f"{tr.get('framesSent', 0)} frames "
+                f"({tr.get('messagesPerFrame', 0):.1f} msgs/frame), "
+                f"loopback {tr.get('loopbackMessages', 0)} / "
+                f"tcp {tr.get('tcpMessages', 0)}, "
+                f"{tr.get('bytesSent', 0)} bytes out"
+                + (
+                    f", {tr['truncationFaults']} truncation faults"
+                    if tr.get("truncationFaults")
+                    else ""
+                )
+            )
         bands = wl.get("latency_bands") or {}
         for leg in ("grv", "read", "commit"):
             b = bands.get(leg) or {}

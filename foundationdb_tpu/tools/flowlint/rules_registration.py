@@ -201,16 +201,30 @@ class RoleMetricsRule(Rule):
                     f"endpoint — the status aggregator cannot pull it",
                 )
 
+    # worker-level (not per-role) observability endpoints: each config key
+    # opts the check in (synthetic fixture trees without the key opt out),
+    # naming the endpoint token the Worker class itself must register
+    WORKER_ENDPOINT_KEYS = (
+        (
+            "process_metrics_endpoint",
+            "worker-process-metrics",
+            "the run-loop profiler's per-process snapshot (slow tasks, "
+            "starvation bands, hot actors) would be invisible to the "
+            "status document's run_loop section and `cli top`",
+        ),
+        (
+            "transport_metrics_endpoint",
+            "worker-transport-metrics",
+            "the transport counters (frames vs messages, loopback/tcp "
+            "split, truncation faults — net/metrics.py) would be "
+            "invisible to the status document's transport section and "
+            "the `cli status` Transport line",
+        ),
+    )
+
     def _check_worker_process_metrics(
         self, modules: dict[str, Module], config: dict
     ) -> Iterator[Finding]:
-        """Worker-level (not per-role) observability: the run-loop
-        profiler endpoint named by config `process_metrics_endpoint` must
-        be registered by the Worker class itself. Config-keyed so
-        synthetic fixture trees without the key opt out."""
-        token = config.get("process_metrics_endpoint")
-        if not token:
-            return
         worker_rel = config.get(
             "worker_module", "foundationdb_tpu/server/worker.py"
         )
@@ -218,18 +232,20 @@ class RoleMetricsRule(Rule):
         if worker is None:
             return
         wcls = _find_class(worker, "Worker")
-        if wcls is not None and _registers_token(wcls, token):
-            return
         node = wcls or (worker.tree.body[0] if worker.tree.body else worker.tree)
-        yield worker.finding(
-            self.id,
-            node,
-            "worker-process-metrics",
-            f"the Worker never registers the `{token}` endpoint — the "
-            f"run-loop profiler's per-process snapshot (slow tasks, "
-            f"starvation bands, hot actors) would be invisible to the "
-            f"status document's run_loop section and `cli top`",
-        )
+        for key, detail, consequence in self.WORKER_ENDPOINT_KEYS:
+            token = config.get(key)
+            if not token:
+                continue
+            if wcls is not None and _registers_token(wcls, token):
+                continue
+            yield worker.finding(
+                self.id,
+                node,
+                detail,
+                f"the Worker never registers the `{token}` endpoint — "
+                f"{consequence}",
+            )
 
 
 def _registered_handlers(cdef: ast.ClassDef) -> dict[str, int]:

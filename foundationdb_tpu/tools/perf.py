@@ -416,6 +416,119 @@ def run_tcp(args) -> dict:
             cluster.stop()
 
 
+def run_tcp_inproc(args) -> dict:
+    """--mode tcp-inproc: the whole cluster — coordinator, workers, client
+    — as RealWorlds on ONE RealLoop in THIS OS process. This is the
+    colocated shape the loopback transport exists for (the bench box runs
+    everything on one core anyway, so loopback TCP syscalls are pure
+    waste), and the transport A/B driver: --transport-legacy pins the
+    gen-6-shaped path (per-message frames, sockets) on the SAME topology.
+    The report embeds the loop's run_loop snapshot, the per-world
+    transport counters, and (with --trace-sample) the span breakdown."""
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)  # never touch a wedged tunnel
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..client.database import Database
+    from ..net.tcp import RealWorld
+    from ..runtime.futures import spawn
+    from ..runtime.knobs import Knobs
+    from ..runtime.loop import RealLoop, set_loop
+    from ..runtime.rng import DeterministicRandom
+    from ..runtime.trace import TraceLog, set_trace_log, trace_log
+    from ..server.coordination import CoordinatorServer
+    from ..server.worker import Worker
+    from ..workloads import run_workloads
+    from .fdbserver import parse_config
+    from .tcp_soak import free_ports
+
+    knobs = Knobs()
+    if args.transport_legacy:
+        knobs.TRANSPORT_FRAME_BATCHING = False
+        knobs.TRANSPORT_LOOPBACK = False
+    if args.no_loopback:
+        knobs.TRANSPORT_LOOPBACK = False
+    if args.no_read_coalescing:
+        knobs.CLIENT_READ_COALESCING = False
+    if args.trace_sample > 0:
+        knobs.TRACE_SAMPLE_RATE = args.trace_sample
+        set_trace_log(TraceLog())
+    cfg = parse_config(args.tcp_config)
+    cfg.setdefault("conflict_backend", args.backend)
+    classes = args.tcp_classes.split(",")
+    loop = RealLoop(args.seed)
+    worlds = []
+    with tempfile.TemporaryDirectory(prefix="fdbtpu-inproc-") as datadir:
+        try:
+            cport, *wports = free_ports(1 + len(classes))
+            coord = f"127.0.0.1:{cport}"
+            cw = RealWorld(
+                coord, knobs=knobs, data_dir=f"{datadir}/c", loop=loop
+            )
+            cw.activate()  # actors spawned below need the loop current
+            CoordinatorServer(disk=cw.disk("coordination")).register(cw.node)
+            worlds.append(cw)
+            for i, (port, pclass) in enumerate(zip(wports, classes)):
+                ww = RealWorld(
+                    f"127.0.0.1:{port}",
+                    knobs=knobs,
+                    data_dir=f"{datadir}/w{i}",
+                    loop=loop,
+                )
+                Worker(
+                    ww.node, [coord], process_class=pclass,
+                    initial_config=cfg, knobs=knobs,
+                ).start()
+                worlds.append(ww)
+            client = RealWorld(
+                "127.0.0.1:0", knobs=knobs, data_dir=f"{datadir}/cl", loop=loop
+            )
+            worlds.append(client)
+            client.activate()
+            db = Database.from_coordinators(client, [coord])
+            w = make_workload(
+                args, db, DeterministicRandom(args.seed),
+                now_fn=time.perf_counter,
+            )
+
+            async def settle(tr):
+                tr.set(b"perfboot", b"ok")
+
+            async def go():
+                await db.run(settle)  # cluster formed end-to-end
+                await run_workloads([w])
+                return True
+
+            client.run_until_done(spawn(go()), 36000.0)
+            report = w.rec.report()
+            if args.trace_sample > 0:
+                from .trace_analyze import critical_path
+
+                report["trace_breakdown"] = critical_path(
+                    trace_log().events, root_prefix="Client."
+                )
+            prof = getattr(loop, "profiler", None)
+            if prof is not None:
+                report["run_loop"] = prof.snapshot(top=8)
+            report["transport"] = {
+                wd.node.address: wd.transport_metrics.snapshot()
+                for wd in worlds
+            }
+            report["transport_knobs"] = {
+                "frame_batching": bool(knobs.TRANSPORT_FRAME_BATCHING),
+                "loopback": bool(knobs.TRANSPORT_LOOPBACK),
+            }
+            return report
+        finally:
+            for wd in worlds:
+                wd.close()
+            set_loop(None)
+            loop.close()
+
+
 def aggregate(reports: list[dict]) -> dict:
     """Sum rates across concurrent client processes; max the percentiles
     (conservative)."""
@@ -442,7 +555,11 @@ def main(argv=None) -> int:
         default="90_10",
         choices=[*PRESETS, "bulkload"],
     )
-    ap.add_argument("--mode", default="sim", choices=["sim", "tcp", "tcp-client"])
+    ap.add_argument(
+        "--mode",
+        default="sim",
+        choices=["sim", "tcp", "tcp-client", "tcp-inproc"],
+    )
     ap.add_argument("--backend", default="oracle", help="sim conflict backend")
     ap.add_argument("--actors", type=int, default=20)
     ap.add_argument("--txns", type=int, default=50)
@@ -483,6 +600,16 @@ def main(argv=None) -> int:
              "queue — the pre-admission park-forever gate) for the "
              "collapse leg of the A/B",
     )
+    ap.add_argument(
+        "--transport-legacy", action="store_true", dest="transport_legacy",
+        help="tcp-inproc: pin the gen-6-shaped transport (per-message "
+             "frames, no loopback) for the A/B leg",
+    )
+    ap.add_argument(
+        "--no-loopback", action="store_true", dest="no_loopback",
+        help="tcp-inproc: keep super-frame batching but force sockets "
+             "(isolates batching from loopback in the A/B)",
+    )
     ap.add_argument("--client-procs", type=int, default=2, dest="client_procs")
     ap.add_argument("--client-id", type=int, default=0, dest="client_id")
     ap.add_argument("--coordinators", default=None)
@@ -507,6 +634,8 @@ def main(argv=None) -> int:
         report = run_sim(args)
     elif args.mode == "tcp":
         report = run_tcp(args)
+    elif args.mode == "tcp-inproc":
+        report = run_tcp_inproc(args)
     else:
         report = run_tcp_client(args, args.coordinators)
 

@@ -210,6 +210,23 @@ def run_one(
             OverloadBurstWorkload(db, rng.fork(), actors=4, txns=5),
         )
     knobs.randomize_admission(shape_rng)
+    # transport draws ride at the VERY end of the sequence (ISSUE 14),
+    # after the admission draws, for the same pinned-seed reason. When the
+    # fault site arms, it rolls on a DEDICATED forked rng — the main chaos
+    # stream stays byte-identical, so arming cannot reshuffle the run
+    knobs.randomize_transport(shape_rng)
+    if knobs.TRANSPORT_FAULT_INJECTION:
+        # bounded chaos episodes (clogging-style): sustained loss on
+        # recovery-critical RPCs would hold the epoch in a recovery storm
+        # forever, a regime a real torn flush cannot produce
+        trng = shape_rng.fork()
+        windows = []
+        t = 4.0
+        for _ in range(2):
+            t += trng.random01() * 15.0
+            windows.append((t, t + 2.5))
+            t += 10.0
+        sim.arm_transport_faults(trng, p=0.02, windows=windows)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)

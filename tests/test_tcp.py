@@ -33,7 +33,16 @@ def run_worlds(main_world, coro, limit=20.0):
 
 
 def make_world(loop):
-    return RealWorld(f"127.0.0.1:{free_port()}", loop=loop)
+    # these tests exercise REAL sockets (framing, reconnects, handshake);
+    # colocated worlds would otherwise auto-select the in-process loopback
+    # (net/loopback.py — covered by tests/test_transport.py)
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    return RealWorld(
+        f"127.0.0.1:{free_port()}",
+        knobs=Knobs(TRANSPORT_LOOPBACK=False),
+        loop=loop,
+    )
 
 
 def test_wire_roundtrip_rich_values():

@@ -1,0 +1,563 @@
+"""Transport v2 (ISSUE 14): frame-batched zero-copy wire path, loopback
+for colocated worlds, batch dispatch, and the truncation fault site.
+
+Layers covered:
+ - wire buffers: O(n) total copying under bursts (the quadratic
+   bytes-concat regression), super-frame encode/parse round-trips, CRC
+   and truncation rejection, mixed legacy+super streams, byte-dribble
+   reassembly;
+ - real sockets: gen-7 vs gen-6 differential (same results, fewer
+   frames), partial-flush truncation fault → typed retryable failure +
+   reconnect (no wedged connection);
+ - loopback: auto-selection for colocated worlds, codec parity (typed
+   errors, unserializable payloads, no aliasing), close semantics;
+ - sim parity: the transport-truncate chaos site fails exactly the
+   faulted request with TransportTruncated (retryable), and the
+   bindingtester oracle stays green with the batching knob both ways;
+ - flowlint: the worker transport.metrics registration rule.
+"""
+
+import socket
+
+import pytest
+
+from foundationdb_tpu.net import wire
+from foundationdb_tpu.net.sim import BrokenPromise, Endpoint, TransportTruncated
+from foundationdb_tpu.net.tcp import RealWorld
+from foundationdb_tpu.runtime.futures import settled, spawn, wait_for_all
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.loop import RealLoop, set_loop
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def make_world(loop, **knob_overrides):
+    return RealWorld(
+        f"127.0.0.1:{free_port()}", knobs=Knobs(**knob_overrides), loop=loop
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire buffers: linear copying under bursts
+
+
+def test_send_buffer_linear_copying_on_1000_message_burst():
+    """Regression for the legacy path's quadratic ``del outbuf[:n]`` churn:
+    a 1,000-message burst drained in small chunks must move O(total)
+    bytes, not O(n^2). bytes_moved counts every compaction relocation."""
+    sb = wire.SendBuffer(watermark=1 << 12)
+    total = 0
+    for i in range(1000):
+        frame = wire.encode_frame(b"m" * 100 + str(i).encode())
+        sb.append(frame)
+        total += len(frame)
+    drained = 0
+    while len(sb):
+        n = min(137, len(sb))  # worst-case fragmented sends
+        drained += n
+        sb.consume(n)
+    assert drained == total
+    # linear bound: compaction may move each byte at most a constant
+    # number of times (watermark amortization), never O(n) times
+    assert sb.bytes_moved <= 2 * total, (sb.bytes_moved, total)
+
+
+def test_recv_buffer_linear_copying_and_compaction():
+    rb = wire.RecvBuffer(size=4096, watermark=1 << 12)
+    payloads = [b"x" * 80 + str(i).encode() for i in range(1000)]
+    stream = b"".join(wire.encode_frame(p) for p in payloads)
+    got = []
+    pos = 0
+    while pos < len(stream):
+        chunk = stream[pos : pos + 333]
+        pos += len(chunk)
+        rb.feed(chunk)
+        views, consumed, _n = wire.parse_frames(rb)
+        got.extend(bytes(v) for v in views)
+        del views
+        rb.consume(consumed)
+    assert got == payloads
+    assert rb.bytes_moved <= 2 * len(stream), (rb.bytes_moved, len(stream))
+
+
+# ---------------------------------------------------------------------------
+# super-frames
+
+
+def test_super_frame_roundtrip_mixed_with_legacy():
+    msgs1 = [b"alpha", b"b" * 500, b""]
+    msgs2 = [b"gamma"]
+    stream = (
+        b"".join(wire.encode_super_frame(msgs1))
+        + wire.encode_frame(b"legacy-single")
+        + b"".join(wire.encode_super_frame(msgs2 * 3))
+    )
+    rb = wire.RecvBuffer()
+    # dribble byte-by-byte: reassembly must never mis-frame
+    got = []
+    for i in range(len(stream)):
+        rb.feed(stream[i : i + 1])
+        views, consumed, _n = wire.parse_frames(rb)
+        got.extend(bytes(v) for v in views)
+        del views
+        rb.consume(consumed)
+    assert got == msgs1 + [b"legacy-single"] + msgs2 * 3
+
+
+def test_super_frame_checksum_and_truncation_rejected():
+    frame = b"".join(wire.encode_super_frame([b"one", b"two"]))
+    bad = bytearray(frame)
+    bad[-1] ^= 0xFF
+    rb = wire.RecvBuffer()
+    rb.feed(bytes(bad))
+    with pytest.raises(wire.WireError):
+        wire.parse_frames(rb)
+    # an internally inconsistent entry table (count lies) must also fail
+    lying = bytearray(frame)
+    import struct as _struct
+    import zlib as _zlib
+
+    entries = frame[12:]
+    _struct.pack_into("<I", lying, 8, 5)  # claim 5 entries
+    _struct.pack_into("<I", lying, 4, _zlib.crc32(entries))
+    rb2 = wire.RecvBuffer()
+    rb2.feed(bytes(lying))
+    with pytest.raises(wire.WireError):
+        wire.parse_frames(rb2)
+
+
+def test_decode_value_from_memoryview_zero_copy_slices():
+    v = (1, "abc", b"\x00\xff" * 50, [True, None, 3.5], {"k": -7})
+    enc = wire.encode_value(v)
+    assert wire.decode_value(memoryview(enc)) == v
+    # truncated memoryview surfaces WireError, not Index/struct errors
+    with pytest.raises(wire.WireError):
+        wire.decode_value(memoryview(enc)[: len(enc) - 3])
+
+
+# ---------------------------------------------------------------------------
+# real sockets: differential + metrics
+
+
+def _rpc_battery(loop, a, b):
+    from foundationdb_tpu.errors import NotCommitted
+    from foundationdb_tpu.net.tcp import RemoteError
+
+    async def echo(x):
+        return ("echo", x)
+
+    async def conflicted(_x):
+        raise NotCommitted("conflict")
+
+    b.node.register("echo", echo)
+    b.node.register("conflict", conflicted)
+
+    async def body():
+        out = []
+        # burst: many same-tick requests — the batching leg must coalesce
+        futs = [
+            a.node.request(Endpoint(b.node.address, "echo"), (i, "p" * i))
+            for i in range(40)
+        ]
+        out.append(await wait_for_all(futs))
+        try:
+            await a.node.request(Endpoint(b.node.address, "nope"), None)
+            out.append("no-bp")
+        except BrokenPromise:
+            out.append("bp")
+        try:
+            await a.node.request(Endpoint(b.node.address, "conflict"), None)
+            out.append("no-nc")
+        except NotCommitted:
+            out.append("nc")
+        except RemoteError:
+            out.append("re")
+        return out
+
+    return a.run_until_done(spawn(body()), 30.0)
+
+
+def test_batched_vs_legacy_socket_differential():
+    """Gen-7 super-frames vs gen-6 per-message frames over real sockets:
+    byte-identical results, strictly fewer frames than messages on the
+    batching leg."""
+    results = {}
+    frames = {}
+    for batching in (True, False):
+        loop = RealLoop(seed=11)
+        a = make_world(
+            loop, TRANSPORT_FRAME_BATCHING=batching, TRANSPORT_LOOPBACK=False
+        )
+        b = make_world(
+            loop, TRANSPORT_FRAME_BATCHING=batching, TRANSPORT_LOOPBACK=False
+        )
+        try:
+            a.activate()
+            results[batching] = _rpc_battery(loop, a, b)
+            snap = a.transport_metrics.snapshot()
+            frames[batching] = (snap["framesSent"], snap["messagesSent"])
+            assert snap["tcpMessages"] > 0 and snap["loopbackMessages"] == 0
+        finally:
+            a.close()
+            b.close()
+            set_loop(None)
+            loop.close()
+    assert results[True] == results[False]
+    f_on, m_on = frames[True]
+    f_off, m_off = frames[False]
+    assert m_on == m_off
+    assert f_off == m_off  # legacy: one frame per message
+    assert f_on < m_on  # batching: the 40-burst coalesced
+
+
+def test_flush_truncation_fault_degrades_per_request_and_reconnects():
+    """A torn super-frame (partial flush + connection death) fails every
+    in-flight request with the retryable BrokenPromise family — nothing
+    hangs, the connection is NOT wedged, and the next request succeeds
+    over a fresh connection."""
+    loop = RealLoop(seed=13)
+    a = make_world(loop, TRANSPORT_LOOPBACK=False)
+    b = make_world(loop, TRANSPORT_LOOPBACK=False)
+
+    async def echo(x):
+        return x
+
+    b.node.register("echo", echo)
+    fired = []
+
+    def tear_once(conn):
+        if not fired:
+            fired.append(conn)
+            return True
+        return False
+
+    async def body():
+        # establish the connection first (the preamble must not be torn)
+        assert await a.node.request(Endpoint(b.node.address, "echo"), 0) == 0
+        a._flush_fault = tear_once
+        futs = [
+            a.node.request(Endpoint(b.node.address, "echo"), i)
+            for i in range(10)
+        ]
+        await wait_for_all([settled(f) for f in futs])
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", f.get()))
+            except BrokenPromise:
+                outcomes.append(("broken", None))
+        # the torn flush killed the batch: every future resolved, none ok
+        assert fired and all(k == "broken" for k, _v in outcomes), outcomes
+        a._flush_fault = None
+        # NOT wedged: a fresh request reconnects and succeeds
+        r = await a.node.request(Endpoint(b.node.address, "echo"), "again")
+        assert r == "again"
+        return True
+
+    try:
+        a.activate()
+        assert a.run_until_done(spawn(body()), 30.0)
+        assert a.transport_metrics.snapshot()["truncationFaults"] == 1
+    finally:
+        a.close()
+        b.close()
+        set_loop(None)
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# loopback
+
+
+def test_loopback_auto_selected_for_colocated_worlds():
+    loop = RealLoop(seed=17)
+    a = make_world(loop)
+    b = make_world(loop)
+    try:
+        a.activate()
+        out = _rpc_battery(loop, a, b)
+        assert out[1] == "bp" and out[2] == "nc"
+        snap = a.transport_metrics.snapshot()
+        assert snap["loopbackMessages"] > 0
+        assert snap["tcpMessages"] == 0  # never touched a socket
+        assert snap["framesSent"] < snap["messagesSent"]  # batched drains
+    finally:
+        a.close()
+        b.close()
+        set_loop(None)
+        loop.close()
+
+
+def test_loopback_codec_parity_no_aliasing_and_unserializable_errors():
+    """Loopback peers exchange CODEC COPIES: mutating a request after
+    send must not leak to the handler, and unserializable payloads fail
+    the sender exactly like the socket path would."""
+    loop = RealLoop(seed=19)
+    a = make_world(loop)
+    b = make_world(loop)
+    seen = []
+
+    async def keep(x):
+        seen.append(x)
+        return len(seen)
+
+    b.node.register("keep", keep)
+
+    async def body():
+        payload = {"k": [1, 2, 3]}
+        f = a.node.request(Endpoint(b.node.address, "keep"), payload)
+        payload["k"].append(99)  # mutate after send, before delivery
+        await f
+        assert seen[0] == {"k": [1, 2, 3]}, seen
+        try:
+            await a.node.request(Endpoint(b.node.address, "keep"), object())
+            return "accepted-unserializable"
+        except wire.WireError:
+            return "rejected"
+
+    try:
+        a.activate()
+        assert a.run_until_done(spawn(body()), 30.0) == "rejected"
+    finally:
+        a.close()
+        b.close()
+        set_loop(None)
+        loop.close()
+
+
+def test_loopback_close_semantics_match_dead_peer():
+    loop = RealLoop(seed=23)
+    a = make_world(loop)
+
+    async def body():
+        b = make_world(loop)
+
+        async def pong(_x):
+            return "pong"
+
+        b.node.register("ping", pong)
+        assert (
+            await a.node.request(Endpoint(b.node.address, "ping"), None)
+        ) == "pong"
+        assert a.transport_metrics.snapshot()["loopbackMessages"] > 0
+        # peer closes: in-flight + subsequent requests break (typed,
+        # retryable), exactly like a dead TCP peer
+        addr = b.node.address
+        b.close()
+        try:
+            await a.node.request(Endpoint(addr, "ping"), None)
+            return "no-break"
+        except BrokenPromise:
+            return "broke"
+
+    try:
+        a.activate()
+        assert a.run_until_done(spawn(body()), 30.0) == "broke"
+    finally:
+        a.close()
+        set_loop(None)
+        loop.close()
+
+
+def test_tls_worlds_never_loop_back(tmp_path):
+    """A TLS world must keep its peer-authentication story: loopback is
+    disabled even for colocated TLS worlds (they talk TLS over sockets).
+    Super-frame batching still rides the TLS stream (the joined-buffer
+    flush path — SSLSocket has no sendmsg)."""
+    from test_tls import gen_ca_and_cert
+
+    crt, key, ca = gen_ca_and_cert(str(tmp_path))
+    tls = dict(certfile=crt, keyfile=key, cafile=ca)
+    loop = RealLoop(seed=29)
+    a = RealWorld(f"127.0.0.1:{free_port()}", knobs=Knobs(), loop=loop, tls=tls)
+    b = RealWorld(f"127.0.0.1:{free_port()}", knobs=Knobs(), loop=loop, tls=tls)
+
+    async def echo(x):
+        return x
+
+    b.node.register("echo", echo)
+
+    async def body():
+        futs = [
+            a.node.request(Endpoint(b.node.address, "echo"), i)
+            for i in range(20)
+        ]
+        return await wait_for_all(futs)
+
+    try:
+        a.activate()
+        assert a.run_until_done(spawn(body()), 60.0) == list(range(20))
+        snap = a.transport_metrics.snapshot()
+        assert snap["loopbackMessages"] == 0
+        assert snap["tcpMessages"] > 0
+        assert snap["framesSent"] < snap["messagesSent"]  # super-framed TLS
+    finally:
+        a.close()
+        b.close()
+        set_loop(None)
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# sim parity: the transport-truncate chaos site
+
+
+def test_sim_transport_fault_fails_only_faulted_request_typed():
+    from foundationdb_tpu.net.sim import Sim
+    from foundationdb_tpu.runtime.rng import DeterministicRandom
+
+    sim = Sim(seed=31)
+    sim.activate()
+    p = sim.new_process("1.1.1.1:1")
+    q = sim.new_process("2.2.2.2:2")
+
+    async def echo(x):
+        return x
+
+    q.register("echo", echo)
+
+    class _AlwaysOnce:
+        """First roll fires, the rest don't."""
+
+        def __init__(self):
+            self.rolls = 0
+
+        def coinflip(self, _p):
+            self.rolls += 1
+            return self.rolls == 1
+
+    sim.arm_transport_faults(_AlwaysOnce(), p=1.0)
+
+    async def body():
+        try:
+            await p.request(Endpoint(q.address, "echo"), "first")
+            return "no-fault"
+        except TransportTruncated as e:
+            assert isinstance(e, BrokenPromise)  # retryable family
+        # per-request degradation: the NEXT request sails through
+        return await p.request(Endpoint(q.address, "echo"), "second")
+
+    assert sim.run_until_done(spawn(body()), 60.0) == "second"
+    assert sim.transport_metrics.snapshot()["truncationFaults"] == 1
+    set_loop(None)
+
+
+def test_commit_pipeline_survives_truncation_burst():
+    """Regression for the version-chain wedge the chaos site exposed:
+    resolve/tlog-commit RPCs eaten mid-pipeline used to tear a permanent
+    hole in the prev→version chain (thousands of TLog.commit handlers
+    parked at the VersionGate forever). With proxy-side retransmission
+    (log_system.retransmitting_request) a fault burst costs retries,
+    never the epoch: commits issued during AND after the burst all
+    succeed without recovery."""
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.net.sim import Sim
+    from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+
+    sim = Sim(seed=53)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(n_proxies=1, n_resolvers=2, n_tlogs=2, n_storage=2),
+        n_coordinators=1,
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    class _Rng:
+        def __init__(self, seed):
+            import random
+
+            self._r = random.Random(seed)
+
+        def coinflip(self, p):
+            return self._r.random() < p
+
+    async def go():
+        async def w(tr, i):
+            tr.set(b"tw%03d" % i, b"v%d" % i)
+
+        # settle the cluster, then arm a hot fault window over live commits
+        await db.run(lambda tr: w(tr, 999))
+        t0 = sim.loop.now()
+        sim.arm_transport_faults(_Rng(1), p=0.08, windows=[(t0, t0 + 3.0)])
+        for i in range(40):
+            await db.run(lambda tr, i=i: w(tr, i))
+        # burst over: the pipeline must still be healthy
+        async def check(tr):
+            rows = await tr.get_range(b"tw", b"tx")
+            return len(rows)
+
+        return await db.run(check)
+
+    assert sim.run_until_done(spawn(go()), 600.0) == 41
+    assert sim.transport_metrics.snapshot()["truncationFaults"] > 0
+    set_loop(None)
+
+
+@pytest.mark.parametrize("batching", [True, False])
+def test_bindingtester_oracle_with_transport_knob(batching):
+    """Semantics gate: the bindingtester oracle must stay green with the
+    transport knob both ways (the knob reshapes framing/batching, never
+    results)."""
+    from test_bindingtester import run_model, run_real
+
+    stream, (data_real, log_real) = run_real(
+        seed=47, n_ops=300,
+        knobs=Knobs(TRANSPORT_FRAME_BATCHING=batching),
+    )
+    data_model, log_model = run_model(stream)
+    assert list(data_real) == list(data_model)
+    assert list(log_real) == list(log_model)
+
+
+# ---------------------------------------------------------------------------
+# flowlint: worker must register transport.metrics
+
+
+def _lint_worker(tmp_path, worker_src):
+    from foundationdb_tpu.tools.flowlint import lint
+
+    pkg = tmp_path / "foundationdb_tpu" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "worker.py").write_text(worker_src)
+    config = {
+        "include": ["foundationdb_tpu"],
+        "exclude": [],
+        "sim_scope": [],
+        "host_only": {},
+        "baseline": "baseline.json",
+        "worker_module": "foundationdb_tpu/server/worker.py",
+        "role_exempt": [],
+        "span_roles": [],
+        "transport_metrics_endpoint": "transport.metrics",
+    }
+    return lint(root=tmp_path, config=config)
+
+
+def test_flowlint_worker_without_transport_metrics_flagged(tmp_path):
+    res = _lint_worker(
+        tmp_path,
+        "class Worker:\n"
+        "    def start(self, process):\n"
+        '        process.register("worker.metrics", self._rm)\n',
+    )
+    assert any(
+        f.rule == "reg-role-metrics" and f.detail == "worker-transport-metrics"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+
+
+def test_flowlint_worker_with_transport_metrics_clean(tmp_path):
+    res = _lint_worker(
+        tmp_path,
+        "class Worker:\n"
+        "    def start(self, process):\n"
+        '        process.register("transport.metrics", self._tm)\n',
+    )
+    assert not res.failing, [f.format() for f in res.failing]
