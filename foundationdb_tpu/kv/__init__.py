@@ -11,6 +11,10 @@ The analog of the reference's common types layer (fdbclient/):
 """
 
 from .mutations import Mutation, MutationType  # noqa: F401
-from .versioned_map import VersionedMap  # noqa: F401
+from .versioned_map import (  # noqa: F401
+    EpochVersionedMap,
+    PinnedSnapshot,
+    VersionedMap,
+)
 from .keyrange_map import KeyRangeMap  # noqa: F401
 from .selector import SELECTOR_END, KeySelector, as_selector  # noqa: F401

@@ -44,6 +44,15 @@ class DiskQueue:
         self._pop_dirty = False
         self._push_gen = 0  # bumped per push; compaction aborts if raced
         self._flip_pending = None  # Future while a compaction meta-flip runs
+        # group commit (ISSUE 15): concurrent commit() callers coalesce —
+        # one physical write+fsync round covers every caller whose pushes
+        # and pops it observed; followers whose work the round made
+        # durable return without their own fsync
+        self._commit_active = None  # Future while a commit round runs
+        self._durable_end = 0  # highest append offset a round made durable
+        self._durable_pop = 0  # highest popped frontier made durable
+        self.commits = 0  # physical write+fsync rounds
+        self.group_joins = 0  # commit() calls satisfied by another round
 
     # -- recovery --------------------------------------------------------------
 
@@ -89,32 +98,59 @@ class DiskQueue:
         return offset
 
     async def commit(self) -> None:
-        """Make all pushed entries (and any pop) durable."""
+        """Make all pushed entries (and any pop) durable.
+
+        Group-committed: while a round's write+fsync is in flight, later
+        callers park on it; a caller whose pushes/pops the finished round
+        covered returns WITHOUT another fsync (N concurrent committers →
+        a bounded number of fsync rounds, not N). The durability contract
+        is unchanged: after commit() returns, everything pushed before
+        the call survives a kill."""
         from ..runtime.buggify import buggify
-        from ..runtime.futures import delay
+        from ..runtime.futures import Future, delay
 
         if buggify():
             await delay(0.002)  # slow fsync (stalls the commit quorum)
-        while self._flip_pending is not None:
-            # a compaction has swapped files but not yet flipped the meta
-            # record: committing (and acking!) into the new file before
-            # the flip is durable would lose the entry if we crash with
-            # the meta still naming the old file
-            await self._flip_pending
-        if self._file is None:
-            # lazy open for a freshly created queue (first commit wins;
-            # the tlog's version gate serializes callers)
-            await self.recover()
-        if self._buffer:
-            blob = b"".join(self._buffer)
-            base = self._buffer_base
-            self._buffer = []
-            self._buffer_base = self._end
-            await self._file.write(base, blob)
-        await self._file.sync()
-        if self._pop_dirty:
-            await self._write_meta()
-            self._pop_dirty = False
+        target_end = self._end
+        target_pop = self._popped
+        while self._commit_active is not None:
+            await self._commit_active
+            if (
+                self._durable_end >= target_end
+                and self._durable_pop >= target_pop
+            ):
+                self.group_joins += 1
+                return
+        self._commit_active = Future()
+        try:
+            while self._flip_pending is not None:
+                # a compaction has swapped files but not yet flipped the meta
+                # record: committing (and acking!) into the new file before
+                # the flip is durable would lose the entry if we crash with
+                # the meta still naming the old file
+                await self._flip_pending
+            if self._file is None:
+                # lazy open for a freshly created queue (first commit wins;
+                # the tlog's version gate serializes callers)
+                await self.recover()
+            end_now = self._end
+            pop_now = self._popped
+            if self._buffer:
+                blob = b"".join(self._buffer)
+                base = self._buffer_base
+                self._buffer = []
+                self._buffer_base = self._end
+                await self._file.write(base, blob)
+            await self._file.sync()
+            if self._pop_dirty:
+                await self._write_meta()
+                self._pop_dirty = False
+            self._durable_end = max(self._durable_end, end_now)
+            self._durable_pop = max(self._durable_pop, pop_now)
+            self.commits += 1
+        finally:
+            done, self._commit_active = self._commit_active, None
+            done._set(None)
 
     async def read_entry(self, offset: int, end: int) -> bytes:
         """Read back one pushed entry by its [offset, end) coordinates —
@@ -137,7 +173,15 @@ class DiskQueue:
         atomically switch the meta record (write-new-then-flip ordering).
         Returns the offset shift applied (0 if nothing happened) so
         callers can rebase any offsets they cached."""
-        if self._popped == 0 or self._buffer or self._flip_pending is not None:
+        if (
+            self._popped == 0
+            or self._buffer
+            or self._flip_pending is not None
+            or self._commit_active is not None
+        ):
+            # an in-flight commit round holds a reference into the current
+            # file; swapping under its write/sync awaits could land an
+            # acked entry only in the about-to-be-removed file
             return 0
         gen = self._push_gen
         live = await self._file.read(0, self._file.size())
@@ -160,6 +204,8 @@ class DiskQueue:
         self._file_id, self._popped = new_id, 0
         self._end -= shift
         self._buffer_base -= shift
+        self._durable_end = max(0, self._durable_end - shift)
+        self._durable_pop = 0
         self._file = new_file
         self._flip_pending = Future()
         try:

@@ -20,6 +20,7 @@ import bisect
 
 from ..runtime.serialize import BinaryReader, BinaryWriter
 from .diskqueue import DiskQueue
+from .versioned_map import merge_sorted_keys
 
 _OP_SET = 0
 _OP_CLEAR = 1
@@ -44,6 +45,10 @@ class KeyValueStoreMemory:
         # disabled must not leak touched keys forever).
         self.track_dirty = False
         self.dirty_keys: dict = {}
+        # sorted-index elements moved by inserts/merges — the bulk-ingest
+        # regression counter (PR 14's RecvBuffer bytes_moved discipline):
+        # per-key insort moves O(n) per NEW key, apply_epoch merges once
+        self.keys_moved = 0
 
     # -- recovery --------------------------------------------------------------
 
@@ -87,7 +92,9 @@ class KeyValueStoreMemory:
         if key not in self._map:
             if self.track_dirty:
                 self.dirty_keys.setdefault(key, False)
-            bisect.insort(self._keys, key)
+            i = bisect.bisect_left(self._keys, key)
+            self.keys_moved += len(self._keys) - i
+            self._keys.insert(i, key)
         self._map[key] = value
         self._ops.u8(_OP_SET).bytes_(key).bytes_(value)
         self._ops_count += 1
@@ -99,9 +106,48 @@ class KeyValueStoreMemory:
             del self._map[k]
             if self.track_dirty:
                 self.dirty_keys.setdefault(k, True)
+        self.keys_moved += len(self._keys) - hi
         del self._keys[lo:hi]
         self._ops.u8(_OP_CLEAR).bytes_(begin).bytes_(end)
         self._ops_count += 1
+
+    def apply_epoch(self, entries: dict, clears=()) -> None:
+        """One durability epoch in a single call (ISSUE 15): range clears
+        first, then the epoch's FINAL per-key entries (builders drop a
+        set that a later clear in the same epoch overwrote, so this
+        normalized order reproduces the in-order result; the op log
+        records the same order for replay). A None entry is a point
+        tombstone (atomic clear). The sorted key index merges ONCE per
+        epoch — O(n + m) — instead of paying an O(n) insort per new key."""
+        for b, e in clears:
+            self.clear_range(b, e)
+        new_keys: list = []
+        dead: list = []
+        for k, v in entries.items():
+            if v is None:
+                self._ops.u8(_OP_CLEAR).bytes_(k).bytes_(k + b"\x00")
+                self._ops_count += 1
+                if k in self._map:
+                    del self._map[k]
+                    dead.append(k)
+                    if self.track_dirty:
+                        self.dirty_keys.setdefault(k, True)
+                continue
+            if k not in self._map:
+                if self.track_dirty:
+                    self.dirty_keys.setdefault(k, False)
+                new_keys.append(k)
+            self._map[k] = v
+            self._ops.u8(_OP_SET).bytes_(k).bytes_(v)
+            self._ops_count += 1
+        for k in dead:
+            i = bisect.bisect_left(self._keys, k)
+            self.keys_moved += len(self._keys) - i - 1
+            del self._keys[i]
+        if new_keys:
+            new_keys.sort()
+            self._keys, moved = merge_sorted_keys(self._keys, new_keys)
+            self.keys_moved += moved
 
     async def commit(self) -> None:
         if self._ops_count:

@@ -61,6 +61,20 @@ class Knobs:
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_WAIT_VERSION_TIMEOUT = 1.0  # then future_version (client retries)
     STORAGE_FETCH_KEYS_BATCH = 10_000
+    # epoch-batched storage engine (ISSUE 15 / ROADMAP item 5): the pull
+    # loop applies each mutation batch as ONE epoch (sorted-index merge
+    # once per batch, native range tombstones), reads pin O(1) immutable
+    # snapshots, and the durability drain is clamped by active pins. Off
+    # = the legacy per-mutation apply path (one-build A/B).
+    STORAGE_EPOCH_BATCHING = True
+    # scan lease: a chunked read that replied `more` pins its version for
+    # this long (refreshed per chunk) so multi-chunk scans, fetchKeys and
+    # backup pages stop racing durability advances into TOO_OLD restarts
+    STORAGE_SNAPSHOT_LEASE = 2.0
+    # bound on how far a pin may hold the durability horizon behind the
+    # tip: past this the advance proceeds and the pin goes TOO_OLD (an
+    # abandoned pin must not grow the MVCC window without limit)
+    STORAGE_PIN_MAX_LAG_VERSIONS = 10_000_000
     # TPU batched-read snapshot index on the storage read path
     # (SURVEY.md's secondary target): serves batch_get misses and
     # getRange bounds, delta-merged each durability epoch. None = AUTO:
@@ -344,6 +358,27 @@ class Knobs:
             )
         if rng.coinflip(0.3):
             self.TRANSPORT_FAULT_INJECTION = True
+
+    def randomize_storage_engine(self, rng) -> None:
+        """Storage-engine knob randomization (ISSUE 15), drawn at the very
+        END of the soak's sequence (after the transport draws) for the
+        pinned-seed reason shared by every post-PR-12 satellite: earlier
+        cluster-shape and workload-rotation draws must reproduce exactly.
+        The knob is consulted when a StorageServer constructs — in the
+        soak that happens inside the sim run (worker recruitment), after
+        these draws land."""
+        if rng.coinflip(0.25):
+            # both engine personalities stay exercised across the matrix
+            self.STORAGE_EPOCH_BATCHING = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            # tiny leases force the TOO_OLD-restart path; long ones hold
+            # the durability horizon across whole scans
+            self.STORAGE_SNAPSHOT_LEASE = rng.random_choice([0.05, 2.0, 10.0])
+        if rng.coinflip(0.25):
+            # a tight pin cap forces the forced-advance pin invalidation
+            self.STORAGE_PIN_MAX_LAG_VERSIONS = rng.random_choice(
+                [6_000_000, 10_000_000, 50_000_000]
+            )
 
     def randomize_read_pipeline(self, rng) -> None:
         """Read-pipeline knob randomization, kept OUT of randomize():

@@ -665,6 +665,25 @@ class ClusterController:
                 "index_reads": sq("multiGetIndexKeys"),
                 "index_fallbacks": sq("multiGetFallbackKeys"),
             },
+            # epoch-batched storage engine (ISSUE 15): batch-apply and
+            # snapshot-pin evidence; oldest_pinned_age_seconds is the
+            # WORST across storages (one overstaying pin is the signal)
+            "storage_engine": {
+                "epochs_applied": sq("epochsApplied"),
+                "epoch_mutations": sq("epochMutations"),
+                "range_tombstones": sq("rangeTombstones"),
+                "snapshots_pinned": sq("snapshotsPinned"),
+                "pinned_now": agg("storage", "pinnedSnapshots"),
+                "oldest_pinned_age_seconds": max(
+                    (
+                        snap.get("oldestPinnedAgeSeconds") or 0
+                        for w in workers.values()
+                        for snap in (w.get("metrics") or {}).values()
+                        if snap.get("kind") == "storage"
+                    ),
+                    default=0,
+                ),
+            },
             "latency_bands": {
                 "grv": band_agg("proxy", "grvLatencyBands"),
                 "commit": band_agg("proxy", "commitLatencyBands"),

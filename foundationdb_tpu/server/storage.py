@@ -22,7 +22,7 @@ from ..kv.atomic import apply_atomic
 from ..kv.engine import KeyValueStoreMemory
 from ..kv.keyrange_map import KeyRangeMap
 from ..kv.mutations import Mutation, MutationType
-from ..kv.versioned_map import VersionedMap
+from ..kv.versioned_map import EpochVersionedMap, VersionedMap
 from ..runtime.futures import AsyncVar, delay, forever, wait_for_any
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
@@ -58,6 +58,11 @@ from .systemdata import (
 
 WAIT_FOR_VERSION_TIMEOUT = 1.0  # default; knob STORAGE_WAIT_VERSION_TIMEOUT
 
+# named chaos site (tools/soak.py coverage report): the durability drain
+# stalls, the MVCC window grows, and pinned/ordinary reads must keep
+# serving off the epoch layers while ingest runs hot
+SITE_EPOCH_STALL = ("server/storage.py", "storage-epoch-stall")
+
 
 class StorageServer:
     def __init__(
@@ -74,7 +79,17 @@ class StorageServer:
         self.log_config = log_config
         self.knobs = knobs or Knobs()
         self.uid = uid
-        self.data = VersionedMap()
+        # epoch-batched MVCC core (ISSUE 15): mutation batches apply as
+        # one epoch each, clears are native range tombstones, reads pin
+        # O(1) snapshots that clamp the durability drain. The legacy
+        # per-mutation map stays behind the knob for one-build A/B.
+        self._epoch_mode = bool(self.knobs.STORAGE_EPOCH_BATCHING)
+        self.data = EpochVersionedMap() if self._epoch_mode else VersionedMap()
+        # scan leases: version → (deadline, pinned_at); a chunked read
+        # that replied `more` holds its version here so the next chunk
+        # (fetchKeys, backup pages, long client scans) doesn't race a
+        # durability advance into TOO_OLD
+        self._scan_pins: dict = {}
         self.version = AsyncVar(0)
         self.durable_version = 0
         self._followed_epoch = -1
@@ -141,6 +156,16 @@ class StorageServer:
         self._c_mg_fallback = self.stats.counter("multiGetFallbackKeys")
         self._l_mg_size = self.stats.latency("multiGetEntriesPerBatch")
         self._l_batch_range = self.stats.latency("batchRangeSeconds")
+        # storage engine (ISSUE 15): epoch-apply and snapshot-pin evidence
+        # — flowlint's reg-role-metrics carries these names in its
+        # role_required_counters config, so the surface cannot go dark
+        self._c_epochs = self.stats.counter("epochsApplied")
+        self._c_epoch_muts = self.stats.counter("epochMutations")
+        self._c_tombstones = self.stats.counter("rangeTombstones")
+        self._c_pins = self.stats.counter("snapshotsPinned")
+        self._l_epoch_size = self.stats.latency("epochMutationsPerApply")
+        self.stats.gauge("pinnedSnapshots", self._pinned_count)
+        self.stats.gauge("oldestPinnedAgeSeconds", self._oldest_pin_age)
         # sim-only read-fault hook: fn(request, reply) → mutated reply
         # (drop / partial / too_old on a subset; tests + chaos soak prove
         # the client degrades to per-key reads without losing RYW)
@@ -150,6 +175,79 @@ class StorageServer:
         self.stats.gauge(
             "windowVersions", lambda: self.version.get() - self.durable_version
         )
+
+    # -- snapshot pins (ISSUE 15) ----------------------------------------------
+
+    def _pinned_count(self) -> int:
+        n = len(self._scan_pins)
+        if self._epoch_mode:
+            n += self.data.pinned_count()
+        return n
+
+    def _oldest_pin_age(self) -> float:
+        t = now()
+        ages = [t - t0 for _d, t0 in self._scan_pins.values()]
+        if self._epoch_mode:
+            pin = self.data.oldest_pin()
+            if pin is not None:
+                ages.append(t - pin.pinned_at)
+        return round(max(ages), 4) if ages else 0.0
+
+    def _pin_read(self, version: Version):
+        """Pin an O(1) snapshot for the duration of a read handler: the
+        durability drain observes the pin and never compacts the layers
+        under an in-flight read. Returns None on the legacy path."""
+        if not self._epoch_mode:
+            return None
+        self._c_pins.add()
+        return self.data.snapshot(version, pinned_at=now())
+
+    def _note_scan_lease(self, version: Version) -> None:
+        """A chunked read replied `more`: lease-pin its version so the
+        follow-up chunk (fetchKeys, backup pages, long scans) is still
+        servable. Refreshed per chunk; expires by deadline so an
+        abandoned scan cannot wedge durability (the pin-lag cap bounds it
+        absolutely)."""
+        if not self._epoch_mode:
+            return
+        lease = self.knobs.STORAGE_SNAPSHOT_LEASE
+        if lease <= 0:
+            return
+        old = self._scan_pins.get(version)
+        self._c_pins.add()
+        self._scan_pins[version] = (
+            now() + lease,
+            old[1] if old else now(),
+        )
+
+    def _clamp_to_pins(self, target: Version) -> Version:
+        """The durability advance the pins allow: live pins hold the
+        horizon at min(pinned) — but only up to STORAGE_PIN_MAX_LAG
+        versions behind the tip, past which the advance proceeds and the
+        overstaying pin goes TOO_OLD."""
+        if not self._epoch_mode:
+            return target
+        t = now()
+        self._scan_pins = {
+            v: lease
+            for v, lease in self._scan_pins.items()
+            if lease[0] > t and v >= self.durable_version
+        }
+        floor = self.data.min_pinned()
+        if self._scan_pins:
+            sp = min(self._scan_pins)
+            floor = sp if floor is None else min(floor, sp)
+        if floor is None or floor >= target:
+            return target
+        cap = max(0, self.version.get() - self.knobs.STORAGE_PIN_MAX_LAG_VERSIONS)
+        new_durable = max(min(target, floor), min(target, cap))
+        if new_durable > floor:
+            # the cap overrode the pins: overstayers go TOO_OLD now, so
+            # the map-level clamp agrees with this advance
+            for pin in self.data._pins.values():
+                if pin.version < new_durable:
+                    pin.invalidated = True
+        return new_durable
 
     # -- mutation pull loop (update:2321) --------------------------------------
 
@@ -164,10 +262,91 @@ class StorageServer:
             for version, mutations in messages:
                 if version <= self.version.get():
                     continue  # already applied (replica failover overlap)
-                for m in mutations:
-                    self._apply(m, version)
+                if self._epoch_mode:
+                    self._apply_epoch_message(version, mutations)
+                else:
+                    for m in mutations:
+                        self._apply(m, version)
             if end > self.version.get():
                 self.version.set(end)
+
+    # -- epoch apply (ISSUE 15: one sorted merge per batch) --------------------
+
+    def _apply_epoch_message(self, version: Version, mutations) -> None:
+        """Apply one version's mutation batch as ONE epoch: the batch
+        reduces to its final per-key entries (a set a later clear in the
+        batch overwrote is dropped here, at build time) plus native range
+        tombstones, then lands in the window — and the durable queue — as
+        a single record. Atomic ops resolve against the epoch's pending
+        state first, so chains within one batch compose exactly as the
+        per-mutation path would."""
+        entries: dict = {}
+        clears: list = []
+        acc = (entries, clears)
+        for m in mutations:
+            self._c_mutations.add()
+            self._c_mutation_bytes.add(len(m.param1) + len(m.param2 or b""))
+            if m.param1.startswith(PRIVATE_PREFIX):
+                self._apply_private(m, version, epoch=acc)
+                continue
+            if not self.own_all:
+                if m.type == MutationType.CLEAR_RANGE:
+                    seen = set()
+                    for b, e, state in self.owned.intersecting(m.param1, m.param2):
+                        if state is not None and state[0] == "adding":
+                            key = self._buffer_key_for(b)
+                            if key is not None and key not in seen:
+                                seen.add(key)
+                                self._fetch_buffers[key].append((m, version))
+                else:
+                    state = self.owned[m.param1]
+                    if state is not None and state[0] == "adding":
+                        key = self._buffer_key_for(m.param1)
+                        if key is not None:
+                            self._fetch_buffers[key].append((m, version))
+                            continue  # point mutation: buffered only
+            if m.type == MutationType.SET_VALUE:
+                entries[m.param1] = m.param2
+            elif m.type == MutationType.CLEAR_RANGE:
+                self._epoch_clear(acc, m.param1, m.param2)
+            elif m.is_atomic():
+                # None result (compare-and-clear) = point tombstone entry
+                entries[m.param1] = apply_atomic(
+                    m.type, self._epoch_base(acc, m.param1), m.param2
+                )
+            else:
+                raise AssertionError(f"storage can't apply {m!r}")
+        if entries or clears:
+            self.data.apply_epoch(version, entries, clears)
+            self._c_epochs.add()
+            self._c_epoch_muts.add(len(entries) + len(clears))
+            self._l_epoch_size.add(float(len(entries) + len(clears)))
+            if self.engine is not None:
+                self._durable_queue.append(("epoch", version, (entries, clears)))
+
+    def _epoch_clear(self, acc, begin: bytes, end: bytes) -> None:
+        entries, clears = acc
+        clears.append((begin, end))
+        self._c_tombstones.add()
+        for k in [k for k in entries if begin <= k < end]:
+            del entries[k]
+
+    def _epoch_base(self, acc, key: bytes):
+        """Base value for an atomic op inside a building epoch: the
+        epoch's own pending state first (entry, else a pending clear
+        covering the key), then the window's latest, then the engine."""
+        entries, clears = acc
+        if key in entries:
+            return entries[key]
+        for b, e in reversed(clears):
+            if b <= key < e:
+                return None
+        known, v = self.data.latest_with_presence(key)
+        if known:
+            return v
+        if self.engine is not None:
+            return self.engine.read_value(key)
+        return None
 
     def _maybe_rollback(self) -> None:
         """On an epoch change, cut back to the old generation's end version
@@ -191,6 +370,14 @@ class StorageServer:
                     To=boundary,
                 )
                 self.data.rollback_after(boundary)
+                # scan leases above the boundary hold cut-off versions:
+                # drop them (their next chunk re-reads and fails TOO_OLD
+                # or FutureVersion like any reader of a dead version)
+                self._scan_pins = {
+                    v: lease
+                    for v, lease in self._scan_pins.items()
+                    if v <= boundary
+                }
                 self._rollback_shard_state(boundary)
                 self._durable_queue = [
                     e for e in self._durable_queue if e[1] <= boundary
@@ -282,23 +469,26 @@ class StorageServer:
             self._durable_queue.append(("mut", version, m))
 
     def _latest_value(self, key: bytes):
-        """Base value for atomic ops: the window's newest entry, falling
-        through to the engine for keys the durability advance dropped
-        (drop_known) — else the in-memory result diverges from the
-        engine's replay of the same op."""
-        h = self.data._hist.get(key)
-        if h:
-            return h[-1][1]
+        """Base value for atomic ops: the window's newest entry (or a
+        newer range tombstone, in epoch mode), falling through to the
+        engine for keys the durability advance dropped (drop_known) —
+        else the in-memory result diverges from the engine's replay of
+        the same op."""
+        known, v = self.data.latest_with_presence(key)
+        if known:
+            return v
         if self.engine is not None:
             return self.engine.read_value(key)
         return None
 
     def _window_clear(self, begin: bytes, end: bytes, version: Version) -> None:
-        """Clear in the MVCC window, tombstoning engine-resident keys too:
-        a key dropped to the engine by drop_known has no window entry, so
-        VersionedMap.clear_range alone would leave reads falling through
-        to the engine's (pre-clear) value until the next durability
-        advance."""
+        """LEGACY-path clear in the MVCC window, tombstoning
+        engine-resident keys too: a key dropped to the engine by
+        drop_known has no window entry, so VersionedMap.clear_range alone
+        would leave reads falling through to the engine's (pre-clear)
+        value until the next durability advance. The epoch path records a
+        native range tombstone instead and never materializes engine rows
+        (_apply_epoch_message / EpochVersionedMap)."""
         if self.engine is not None:
             for k, _v in self.engine.read_range(begin, end):
                 if k not in self.data._hist:
@@ -313,10 +503,12 @@ class StorageServer:
 
     # -- shard assignment (privatized metadata; fetchKeys:1761) ----------------
 
-    def _apply_private(self, m, version: Version) -> None:
+    def _apply_private(self, m, version: Version, epoch=None) -> None:
         """Privatized metadata mutations: interpreted (shard-assignment
         changes), never stored as data (ApplyMetadataMutation's \\xff\\xff
-        handling)."""
+        handling). ``epoch`` is the building (entries, clears) accumulator
+        on the epoch-batched path: a shard-drop's data clear rides the
+        epoch as a range tombstone instead of a per-mutation queue entry."""
         key = m.param1[len(PRIVATE_PREFIX) :]
         if not key.startswith(KEY_SERVERS_PREFIX):
             return
@@ -385,20 +577,27 @@ class StorageServer:
             self.owned.insert(begin, end, None)
             self._fetch_buffers.pop((begin, end), None)
             self._fetch_info.pop((begin, end), None)
-            self._window_clear(begin, end or b"\xff\xff\xff\xff\xff", version)
+            clear_end = end or b"\xff\xff\xff\xff\xff"
+            if epoch is not None:
+                # epoch path: the drop's clear is a native range tombstone
+                # in the building epoch (drained to the engine with it)
+                self._epoch_clear(epoch, begin, clear_end)
+            else:
+                self._window_clear(begin, clear_end, version)
             if self.engine is not None:
                 self._durable_queue.append(("own", version, (begin, end, None)))
-                self._durable_queue.append(
-                    (
-                        "mut",
-                        version,
-                        Mutation(
-                            MutationType.CLEAR_RANGE,
-                            begin,
-                            end or b"\xff\xff\xff\xff\xff",
-                        ),
+                if epoch is None:
+                    self._durable_queue.append(
+                        (
+                            "mut",
+                            version,
+                            Mutation(
+                                MutationType.CLEAR_RANGE,
+                                begin,
+                                clear_end,
+                            ),
+                        )
                     )
-                )
 
     async def _fetch_keys(self, begin, end, sources, move_version):
         """Fetch [begin, end) from the old team at a snapshot, splice the
@@ -482,8 +681,14 @@ class StorageServer:
                 else:
                     state[m.param1] = nv
         ready_version = self.version.get()
-        for k in sorted(state):
-            self.data.set(k, state[k], ready_version)
+        if self._epoch_mode:
+            # the spliced snapshot lands as ONE epoch: one sorted-index
+            # merge instead of an insort per fetched row
+            if state:
+                self.data.apply_epoch(ready_version, dict(state))
+        else:
+            for k in sorted(state):
+                self.data.set(k, state[k], ready_version)
         self.owned.insert(begin, end, ("owned", ready_version))
         if self.engine is not None:
             self._durable_queue.append(
@@ -509,10 +714,15 @@ class StorageServer:
             await delay(
                 0.02 if buggify() else self.knobs.STORAGE_DURABILITY_LAG
             )  # eager durability: shrink the in-memory MVCC window
+            if self._epoch_mode and buggify(SITE_EPOCH_STALL):
+                # chaos: the drain stalls and the window grows — reads
+                # (pinned or not) must keep serving off the epoch layers
+                await delay(0.25)
             new_durable = max(
                 0,
                 self.version.get() - self.knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS,
             )
+            new_durable = self._clamp_to_pins(new_durable)
             if new_durable > self.durable_version:
                 if self.engine is not None:
                     # the engine is mutated ahead of the window compaction:
@@ -548,7 +758,13 @@ class StorageServer:
         q = self._durable_queue
         while i < len(q) and q[i][1] <= new_durable:
             kind, _v, payload = q[i]
-            if kind == "mut":
+            if kind == "epoch":
+                # one engine call per epoch: clears (range tombstones)
+                # first, then the epoch's final entries — a single sorted
+                # merge of the key index instead of per-key insorts
+                entries, clears = payload
+                self.engine.apply_epoch(entries, clears)
+            elif kind == "mut":
                 m = payload
                 if m.type == MutationType.SET_VALUE:
                     self.engine.set(m.param1, m.param2)
@@ -563,8 +779,7 @@ class StorageServer:
                     else:
                         self.engine.set(m.param1, nv)
             elif kind == "rows":
-                for k, v in payload:
-                    self.engine.set(k, v)
+                self.engine.apply_epoch(dict(payload))
             elif kind == "own":
                 begin, end, state = payload
                 self._persist_owned.insert(begin, end, state)
@@ -690,9 +905,14 @@ class StorageServer:
                 )
             self._check_read(req.key, req.key + b"\x00", req.version)
             t_eng = now()
-            known, value = self.data.get_with_presence(req.key, req.version)
-            if not known and self.engine is not None:
-                value = self.engine.read_value(req.key)
+            pin = self._pin_read(req.version)
+            try:
+                known, value = self.data.get_with_presence(req.key, req.version)
+                if not known and self.engine is not None:
+                    value = self.engine.read_value(req.key)
+            finally:
+                if pin is not None:
+                    pin.release()
             if sp.sampled:
                 emit_span("Storage.engine", self._proc_addr(), sp, t_eng, now())
                 sp.event("StorageRead", kind="ReadDebug")
@@ -720,9 +940,14 @@ class StorageServer:
             # tiny replies force every caller through its `more`/windowing path
             limit = 1 if buggify() else req.limit
             t_eng = now()
-            data = self._read_range_merged(
-                req.begin, req.end, req.version, limit + 1, req.reverse
-            )
+            pin = self._pin_read(req.version)
+            try:
+                data = self._read_range_merged(
+                    req.begin, req.end, req.version, limit + 1, req.reverse
+                )
+            finally:
+                if pin is not None:
+                    pin.release()
             if sp.sampled:
                 emit_span(
                     "Storage.engine", self._proc_addr(), sp, t_eng, now(),
@@ -730,6 +955,11 @@ class StorageServer:
                 )
                 sp.event("StorageRead", kind="ReadDebug")
         more = len(data) > limit
+        if more:
+            # a continuation is coming at this same version: lease-pin it
+            # so the next chunk doesn't race the durability drain TOO_OLD
+            # (fetchKeys sources, backup pages, long client scans)
+            self._note_scan_lease(req.version)
         dt = now() - t0
         self._c_queries.add()
         self._l_read.add(dt)
@@ -816,10 +1046,20 @@ class StorageServer:
             return GetKeyReply(key=b"", resolved=True)
         return GetKeyReply(key=s_begin, offset=off + len(rows), resolved=False)
 
+    @staticmethod
+    def _clear_covered(clears, key) -> bool:
+        for b, e in clears:
+            if b <= key < e:
+                return True
+        return False
+
     def _read_range_merged(self, begin, end, version, limit, reverse,
                            engine_bounds=None):
         """Window-over-engine merge (the reference's readRange:916 merge of
-        the in-memory versioned tree with the durable engine).
+        the in-memory versioned tree with the durable engine). On the
+        epoch path the window contributes native range tombstones too:
+        engine rows they cover are masked without the window ever having
+        materialized per-key tombstones for them.
         ``engine_bounds``: precomputed index row bounds for this range
         (multiGetRange resolves every range's bounds in one batched
         interval query)."""
@@ -827,17 +1067,19 @@ class StorageServer:
             return self.data.range(
                 begin, end, version, limit=limit, reverse=reverse
             )
-        win = self.data.entries_with_tombstones(begin, end, version)
-        overlay = dict(win)
+        overlay, wclears = self.data.window_view(begin, end, version)
         if reverse:
-            return self._merged_reverse(begin, end, overlay, limit)
-        want = limit + len(win) + 1
+            return self._merged_reverse(begin, end, overlay, limit, wclears)
+        want = limit + len(overlay) + 1
         while True:
             base = self._engine_range(begin, end, want, bounds=engine_bounds)
             # the engine's local metadata rows (\xff\xff/local/...) are
             # not data — they must not leak into client scans or fetchKeys
             merged = {
-                k: v for k, v in base if not k.startswith(PRIVATE_PREFIX)
+                k: v
+                for k, v in base
+                if not k.startswith(PRIVATE_PREFIX)
+                and not (wclears and self._clear_covered(wclears, k))
             }
             for k, v in overlay.items():
                 if v is None:
@@ -850,7 +1092,7 @@ class StorageServer:
                 return rows[:limit]
             want *= 2
 
-    def _merged_reverse(self, begin, end, overlay, limit):
+    def _merged_reverse(self, begin, end, overlay, limit, wclears=()):
         """Bounded chunked backward walk: each chunk reads the engine's
         LAST ``want`` rows below ``hi`` (O(want), kv/engine.py reverse
         read); inside [chunk_lo, hi) the engine rows are complete, so the
@@ -867,7 +1109,10 @@ class StorageServer:
             exhausted = len(base) < want
             chunk_lo = begin if exhausted else base[-1][0]
             merged = {
-                k: v for k, v in base if not k.startswith(PRIVATE_PREFIX)
+                k: v
+                for k, v in base
+                if not k.startswith(PRIVATE_PREFIX)
+                and not (wclears and self._clear_covered(wclears, k))
             }
             for k, v in overlay.items():
                 if chunk_lo <= k < hi:
@@ -994,19 +1239,24 @@ class StorageServer:
                     "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
                 )
             t_eng = now()
-            values, errors = self._multi_get_at(req.keys, req.version)
-            sel_replies, sel_errors = [], []
-            for i, sel in enumerate(req.selectors):
-                key, offset, begin, end = sel
-                greq = GetKeyRequest(
-                    key=key, offset=offset, version=req.version,
-                    begin=begin, end=end,
-                )
-                try:
-                    sel_replies.append(self._get_key_at(greq))
-                except WrongShardServer:
-                    sel_replies.append(None)
-                    sel_errors.append((i, READ_ERR_WRONG_SHARD))
+            pin = self._pin_read(req.version)
+            try:
+                values, errors = self._multi_get_at(req.keys, req.version)
+                sel_replies, sel_errors = [], []
+                for i, sel in enumerate(req.selectors):
+                    key, offset, begin, end = sel
+                    greq = GetKeyRequest(
+                        key=key, offset=offset, version=req.version,
+                        begin=begin, end=end,
+                    )
+                    try:
+                        sel_replies.append(self._get_key_at(greq))
+                    except WrongShardServer:
+                        sel_replies.append(None)
+                        sel_errors.append((i, READ_ERR_WRONG_SHARD))
+            finally:
+                if pin is not None:
+                    pin.release()
             if sp.sampled:
                 emit_span(
                     "Storage.engine", self._proc_addr(), sp, t_eng, now(),
@@ -1059,30 +1309,41 @@ class StorageServer:
                     "Storage.waitVersion", self._proc_addr(), sp, t_wait, now()
                 )
             t_eng = now()
-            bounds = self._multi_engine_bounds(req.ranges)
-            results, errors = [], []
-            rows_total = 0
-            for i, rng in enumerate(req.ranges):
-                begin, end, limit, reverse = rng
-                try:
-                    self._check_read(begin, end, req.version)
-                except WrongShardServer:
-                    results.append(None)
-                    errors.append((i, READ_ERR_WRONG_SHARD))
-                    continue
-                # tiny replies force every caller through its `more` path
-                limit_i = 1 if buggify() else limit
-                data = self._read_range_merged(
-                    begin, end, req.version, limit_i + 1, reverse,
-                    engine_bounds=None if bounds is None else bounds[i],
-                )
-                more = len(data) > limit_i
-                results.append(GetKeyValuesReply(data=data[:limit_i], more=more))
-                rows_total += min(len(data), limit_i)
-                self._c_rows.add(min(len(data), limit_i))
-                self._c_bytes_q.add(
-                    sum(len(k) + len(v) for k, v in data[:limit_i])
-                )
+            pin = self._pin_read(req.version)
+            any_more = False
+            try:
+                bounds = self._multi_engine_bounds(req.ranges)
+                results, errors = [], []
+                rows_total = 0
+                for i, rng in enumerate(req.ranges):
+                    begin, end, limit, reverse = rng
+                    try:
+                        self._check_read(begin, end, req.version)
+                    except WrongShardServer:
+                        results.append(None)
+                        errors.append((i, READ_ERR_WRONG_SHARD))
+                        continue
+                    # tiny replies force every caller through its `more` path
+                    limit_i = 1 if buggify() else limit
+                    data = self._read_range_merged(
+                        begin, end, req.version, limit_i + 1, reverse,
+                        engine_bounds=None if bounds is None else bounds[i],
+                    )
+                    more = len(data) > limit_i
+                    any_more = any_more or more
+                    results.append(
+                        GetKeyValuesReply(data=data[:limit_i], more=more)
+                    )
+                    rows_total += min(len(data), limit_i)
+                    self._c_rows.add(min(len(data), limit_i))
+                    self._c_bytes_q.add(
+                        sum(len(k) + len(v) for k, v in data[:limit_i])
+                    )
+            finally:
+                if pin is not None:
+                    pin.release()
+            if any_more:
+                self._note_scan_lease(req.version)
             if sp.sampled:
                 emit_span(
                     "Storage.engine", self._proc_addr(), sp, t_eng, now(),
@@ -1139,7 +1400,12 @@ class StorageServer:
             "Storage.batchGet", self._proc_addr(), storage=self.uid, keys=len(keys)
         ):
             await self._wait_for_version(version)
-            out, errors = self._multi_get_at(keys, version)
+            pin = self._pin_read(version)
+            try:
+                out, errors = self._multi_get_at(keys, version)
+            finally:
+                if pin is not None:
+                    pin.release()
             if errors:
                 raise WrongShardServer()
         dt = now() - t0

@@ -150,6 +150,25 @@ class FdbCli:
                 f"index {idx_r.get('counter', 0)} / "
                 f"fallback {idx_f.get('counter', 0)})"
             )
+        se = (doc.get("workload") or {}).get("storage_engine") or {}
+        if (se.get("epochs_applied") or {}).get("counter"):
+            ea = se["epochs_applied"]
+            em = se.get("epoch_mutations") or {}
+            n_epochs = ea.get("counter") or 0
+            n_muts = em.get("counter") or 0
+            age = se.get("oldest_pinned_age_seconds") or 0
+            lines.append(
+                f"Storage engine: {n_epochs} epochs applied "
+                f"({n_muts} mutations, "
+                f"{n_muts / max(n_epochs, 1):.1f} muts/epoch, "
+                f"{ea.get('hz') or 0:.0f} epochs/s), "
+                f"{(se.get('range_tombstones') or {}).get('counter', 0)} "
+                f"range tombstones, "
+                f"{(se.get('snapshots_pinned') or {}).get('counter', 0)} "
+                f"snapshots pinned ({se.get('pinned_now') or 0} now"
+                + (f", oldest {age:.1f}s" if age else "")
+                + ")"
+            )
         tr = (doc.get("transport") or {}).get("total") or {}
         if tr.get("messagesSent"):
             lines.append(

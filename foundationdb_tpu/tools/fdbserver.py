@@ -71,13 +71,17 @@ def main(argv=None) -> int:
     knob_overrides = {}
     for kv in args.knob:
         name, _, val = kv.partition("=")
-        try:
-            parsed: object = int(val)
-        except ValueError:
+        if val.lower() in ("true", "false"):
+            # bool knobs: the bare string "false" would be truthy
+            parsed: object = val.lower() == "true"
+        else:
             try:
-                parsed = float(val)
+                parsed = int(val)
             except ValueError:
-                parsed = val
+                try:
+                    parsed = float(val)
+                except ValueError:
+                    parsed = val
         knob_overrides[name.upper()] = parsed
     knobs = Knobs(**knob_overrides)
 

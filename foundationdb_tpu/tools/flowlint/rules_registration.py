@@ -152,6 +152,34 @@ def _has_metrics_endpoint(cdef: ast.ClassDef) -> bool:
     return False
 
 
+STATS_REGISTRATION_METHODS = {"counter", "latency", "bands", "gauge"}
+
+
+def _registered_stat_names(cdef: ast.ClassDef) -> set:
+    """String names registered on the class's CounterCollection: the
+    first literal argument of every ``self.stats.counter/latency/bands/
+    gauge(...)`` call in the class body."""
+    out: set = set()
+    for n in ast.walk(cdef):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
+            continue
+        if n.func.attr not in STATS_REGISTRATION_METHODS:
+            continue
+        target = n.func.value
+        if not (
+            isinstance(target, ast.Attribute)
+            and target.attr == "stats"
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            continue
+        if n.args and isinstance(n.args[0], ast.Constant) and isinstance(
+            n.args[0].value, str
+        ):
+            out.add(n.args[0].value)
+    return out
+
+
 def _registers_token(cdef: ast.ClassDef, token: str) -> bool:
     """True when the class body contains a ``*.register(<token>, ...)``
     call with the token as a literal first argument."""
@@ -200,6 +228,25 @@ class RoleMetricsRule(Rule):
                     f"role `{kind}`: {cls_name} registers no `*.metrics#` "
                     f"endpoint — the status aggregator cannot pull it",
                 )
+            # config-keyed counter manifest: counters a status/cli surface
+            # depends on by NAME (e.g. the storage-engine epoch/pin
+            # counters behind the `Storage engine:` line) must stay
+            # registered — renaming or dropping one silently blanks the
+            # surface, so the config pins the contract here
+            required = (config.get("role_required_counters") or {}).get(kind)
+            if required:
+                present = _registered_stat_names(cdef)
+                for name in required:
+                    if name not in present:
+                        yield home.finding(
+                            self.id,
+                            cdef,
+                            f"{cls_name}-counter-{name}",
+                            f"role `{kind}`: {cls_name} no longer registers "
+                            f"the `{name}` counter that "
+                            f"role_required_counters pins — the status/cli "
+                            f"surface built on it has gone dark",
+                        )
 
     # worker-level (not per-role) observability endpoints: each config key
     # opts the check in (synthetic fixture trees without the key opt out),

@@ -67,6 +67,8 @@ def run_sim(args) -> dict:
     sim.knobs.SIM_MAX_LATENCY = 0.001
     if args.no_read_coalescing:
         sim.knobs.CLIENT_READ_COALESCING = False
+    if args.storage_legacy_engine:
+        sim.knobs.STORAGE_EPOCH_BATCHING = False
     if args.trace_sample > 0:
         # span tracing for stage attribution: a fresh TraceLog so the
         # breakdown covers exactly this run
@@ -350,6 +352,11 @@ def run_tcp(args) -> dict:
             datadir,
             config=args.tcp_config,
             classes=tuple(args.tcp_classes.split(",")),
+            knobs=(
+                ("STORAGE_EPOCH_BATCHING=false",)
+                if args.storage_legacy_engine
+                else ()
+            ),
         )
         try:
             wait_for(
@@ -453,6 +460,8 @@ def run_tcp_inproc(args) -> dict:
         knobs.TRANSPORT_LOOPBACK = False
     if args.no_read_coalescing:
         knobs.CLIENT_READ_COALESCING = False
+    if args.storage_legacy_engine:
+        knobs.STORAGE_EPOCH_BATCHING = False
     if args.trace_sample > 0:
         knobs.TRACE_SAMPLE_RATE = args.trace_sample
         set_trace_log(TraceLog())
@@ -599,6 +608,12 @@ def main(argv=None) -> int:
         help="overload driver: disable shedding (unbounded deadline-free "
              "queue — the pre-admission park-forever gate) for the "
              "collapse leg of the A/B",
+    )
+    ap.add_argument(
+        "--storage-legacy-engine", action="store_true",
+        dest="storage_legacy_engine",
+        help="pin STORAGE_EPOCH_BATCHING off cluster-wide (the per-"
+             "mutation apply path) for the storage-engine A/B leg",
     )
     ap.add_argument(
         "--transport-legacy", action="store_true", dest="transport_legacy",

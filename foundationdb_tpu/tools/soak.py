@@ -227,6 +227,14 @@ def run_one(
             windows.append((t, t + 2.5))
             t += 10.0
         sim.arm_transport_faults(trng, p=0.02, windows=windows)
+    # storage-engine draws (ISSUE 15) are the NEW end of the sequence —
+    # appended after every earlier draw so pinned seeds reproduce exactly.
+    # STORAGE_EPOCH_BATCHING is consulted when a StorageServer CONSTRUCTS,
+    # which in this soak happens inside the sim run (worker recruitment,
+    # after these draws land) — so both engine personalities, the scan
+    # leases, the pin-lag cap, and the storage-epoch-stall chaos site
+    # (armed through the ordinary buggify machinery) all get exercised
+    knobs.randomize_storage_engine(shape_rng)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)
@@ -254,6 +262,126 @@ def run_one(
     }
 
 
+def mixed_soak(
+    seed: int = 0,
+    duration: float = 30.0,
+    verbose: bool = False,
+    epoch_batching=None,
+) -> dict:
+    """Sustained mixed soak (ISSUE 15 acceptance): readwrite clients, bulk
+    ingest, and a backup run CONCURRENTLY against a durable-engine sim
+    cluster while the CC latency probe keeps timing reads. The claim under
+    test is FLATNESS — reads pin O(1) snapshots and the epoch drain never
+    blocks them, so the read-probe p95 of the run's last third must not
+    grow away from the first third while ingest runs hot. Returns the
+    per-third probe p95s plus the cluster's storage_engine roll-up.
+
+    Run: python -m foundationdb_tpu.tools.soak --mixed [duration] [seed]
+    """
+    from ..client import management
+    from ..runtime.futures import delay
+    from ..runtime.loop import Cancelled, now as model_now
+    from ..workloads.readwrite import BulkLoadWorkload, ThroughputWorkload
+
+    knobs = Knobs(LATENCY_PROBE_INTERVAL=0.25)
+    if epoch_batching is not None:
+        knobs.STORAGE_EPOCH_BATCHING = epoch_batching
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_proxies=1, n_tlogs=1, n_storage=2)
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    rng = sim.loop.random
+
+    samples: list = []  # (model_time, latest read-probe seconds)
+    last_status = [{}]
+    done = [False]
+
+    async def sampler():
+        while not done[0]:
+            await delay(0.25)
+            try:
+                doc = await management.get_status(
+                    cluster.coordinators, db.client
+                )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
+            except Exception:
+                continue
+            last_status[0] = doc
+            rp = (doc.get("latency_probe") or {}).get("read_seconds")
+            if rp is not None:
+                samples.append((model_now(), rp))
+
+    clients = ThroughputWorkload(
+        db,
+        rng.fork(),
+        duration=duration,
+        actors=8,
+        reads_per_txn=5,
+        writes_per_txn=5,
+        parallel_reads=True,
+    )
+    # ingest sized to the run length so the apply path stays hot end-to-end
+    bulk = BulkLoadWorkload(
+        db,
+        rng.fork(),
+        actors=4,
+        txns_per_actor=max(10, int(duration * 6)),
+        keys_per_txn=50,
+    )
+    backup = BackupWorkload(db, rng.fork(), sim=sim, writes=20)
+
+    async def go():
+        s = spawn(sampler())
+        try:
+            await run_workloads([clients, bulk, backup])
+        finally:
+            done[0] = True
+            s.cancel()
+        return True
+
+    assert sim.run_until_done(spawn(go()), 36000.0)
+
+    def p95(vals):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(len(vals) * 0.95))], 6)
+
+    thirds: list = [[], [], []]
+    if samples:
+        t0, t1 = samples[0][0], samples[-1][0]
+        span = (t1 - t0) or 1.0
+        for t, v in samples:
+            thirds[min(2, int((t - t0) / span * 3))].append(v)
+    doc = last_status[0]
+    se = (doc.get("workload") or {}).get("storage_engine") or {}
+    out = {
+        "seed": seed,
+        "duration_model_s": duration,
+        "probe_samples": len(samples),
+        "read_p95_by_third": [p95(t) for t in thirds],
+        "read_p95_overall": p95([v for t in thirds for v in t]),
+        "epoch_batching": bool(knobs.STORAGE_EPOCH_BATCHING),
+        "clients": clients.rec.report(),
+        "bulkload_keys": bulk.rec.writes,
+        "storage_engine": {
+            k: (v.get("counter") if isinstance(v, dict) else v)
+            for k, v in se.items()
+        },
+    }
+    if verbose:
+        print(
+            f"mixed soak seed {seed}: {len(samples)} probe samples, read "
+            f"p95 by third {out['read_p95_by_third']}, "
+            f"{out['bulkload_keys']} bulk keys ingested, storage engine "
+            f"{out['storage_engine']}"
+        )
+    return out
+
+
 def buggify_site_names(fired) -> list:
     """Human-readable fired-site names for the coverage report: code sites
     render as `file.py:line`, named sites (the kernel-fault injector's)
@@ -270,6 +398,16 @@ def buggify_site_names(fired) -> list:
 
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--mixed":
+        import json
+
+        duration = float(argv[1]) if len(argv) > 1 else 30.0
+        seed = int(argv[2]) if len(argv) > 2 else 0
+        out = mixed_soak(seed=seed, duration=duration, verbose=True)
+        print(json.dumps(out, default=str))
+        thirds = [p for p in out["read_p95_by_third"] if p is not None]
+        # flatness gate: the last third must not run away from the first
+        return 0 if (len(thirds) < 2 or thirds[-1] <= 3 * thirds[0]) else 1
     n = int(argv[0]) if argv else 20
     first = int(argv[1]) if len(argv) > 1 else 0
     failures = []

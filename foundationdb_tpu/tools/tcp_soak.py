@@ -82,15 +82,20 @@ class TcpCluster:
     """A real-process cluster: one coordinator + classed workers."""
 
     def __init__(self, datadir, config="n_storage=2,replication=1,n_tlogs=1",
-                 classes=("storage", "storage", "transaction", "stateless")):
+                 classes=("storage", "storage", "transaction", "stateless"),
+                 knobs=()):
         self.datadir = datadir
         self.config = config
+        # server-side knob overrides ("NAME=value" strings, the fdbserver
+        # --knob flag path) — the bench A/B drivers pin e.g.
+        # STORAGE_EPOCH_BATCHING per leg through here
+        knob_args = [a for kv in knobs for a in ("--knob", kv)]
         cport, *wports = free_ports(1 + len(classes))
         self.coord = f"127.0.0.1:{cport}"
         self.procs: dict[str, subprocess.Popen] = {}
         self.spawn_args: dict[str, list] = {}
         args = ["--listen", self.coord, "--role", "coordinator",
-                "--datadir", os.path.join(datadir, "coord")]
+                "--datadir", os.path.join(datadir, "coord")] + knob_args
         self.spawn_args["coord"] = args
         self.procs["coord"] = spawn_server(args)
         for port, pclass in zip(wports, classes):
@@ -102,7 +107,7 @@ class TcpCluster:
                 "--coordinators", self.coord,
                 "--config", config,
                 "--datadir", os.path.join(datadir, name),
-            ]
+            ] + knob_args
             self.spawn_args[name] = args
             self.procs[name] = spawn_server(args)
 
