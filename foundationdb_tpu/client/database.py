@@ -243,6 +243,14 @@ class Database:
         self.client.spawn(self._watch_actor(key, out))
         return out
 
+    def change_feed(self, begin: bytes, end: bytes, from_version: int = 0):
+        """A resumable cursor over the range's committed mutations in
+        version order (client/feed.py). The range must live on one shard;
+        ``from_version`` is exclusive (0 = from the retention floor)."""
+        from .feed import ChangeFeed
+
+        return ChangeFeed(self, begin, end, from_version)
+
     async def _watch_actor(
         self, key: bytes, out, baseline_version=None, baseline_value=_NO_VALUE
     ) -> None:
@@ -263,53 +271,84 @@ class Database:
         pre-write value at its read version (which would fire the watch
         immediately and spuriously, turning watch loops into busy
         polls)."""
-        from ..errors import FdbError, TransactionTooOld
+        from ..errors import FdbError, TransactionCancelled, TransactionTooOld
+        from ..runtime.loop import now
+        from ..runtime.trace import emit_span, swap_active_span
         from ..server.interfaces import Tokens as T
         from ..server.interfaces import WatchValueRequest
 
         baseline_known = baseline_value is not _NO_VALUE
         v0 = None if not baseline_known else baseline_value
-        while not out.is_ready():
-            try:
-                tr = self.transaction()
-                if not baseline_known:
-                    # the baseline is captured ONCE: a change landing
-                    # during a failover retry must still fire the watch,
-                    # not silently become the new baseline
-                    if baseline_version is not None:
-                        try:
-                            tr.set_read_version(baseline_version)
-                            v0 = await tr.get(key, snapshot=True)
-                        except TransactionTooOld:
-                            # the txn's version fell out of the MVCC
-                            # window — the value may have changed since,
-                            # unobservably: fire (watches may fire
-                            # spuriously; they must never be lost)
-                            tr = self.transaction()
-                            v0 = await tr.get(key, snapshot=True)
-                            if not out.is_ready():
-                                out._set(v0)
-                            return
+        # Client.watch spans the whole register→fire lifetime (possibly
+        # across failover re-registrations); the root comes from the first
+        # internal transaction's sampling decision, and the registration
+        # RPC carries it so Storage.watchFire joins the same trace.
+        t0 = now()
+        root = None
+        try:
+            while not out.is_ready():
+                try:
+                    tr = self.transaction()
+                    if root is None:
+                        root = tr._trace_root()
                     else:
-                        v0 = await tr.get(key, snapshot=True)
-                    baseline_known = True
-                else:
-                    await tr.get_read_version()
-                req = WatchValueRequest(
-                    key=key, value=v0, version=tr._read_version
-                )
-                reply = await tr._load_balanced(key, T.WATCH_VALUE, req)
-                if not out.is_ready():
-                    out._set(reply.value)
-                return
-            except (FdbError, BrokenPromise):
-                await delay(0.1)
-            except Cancelled:
-                raise  # actor-cancelled-swallow
-            except Exception as e:
-                if not out.is_ready():
-                    out._set_error(e)
-                return
+                        tr.set_debug_id(root.trace_id)
+                    if not baseline_known:
+                        # the baseline is captured ONCE: a change landing
+                        # during a failover retry must still fire the watch,
+                        # not silently become the new baseline
+                        if baseline_version is not None:
+                            try:
+                                tr.set_read_version(baseline_version)
+                                v0 = await tr.get(key, snapshot=True)
+                            except TransactionTooOld:
+                                # the txn's version fell out of the MVCC
+                                # window — the value may have changed since,
+                                # unobservably: fire (watches may fire
+                                # spuriously; they must never be lost)
+                                tr = self.transaction()
+                                v0 = await tr.get(key, snapshot=True)
+                                if not out.is_ready():
+                                    out._set(v0)
+                                return
+                        else:
+                            v0 = await tr.get(key, snapshot=True)
+                        baseline_known = True
+                    else:
+                        await tr.get_read_version()
+                    req = WatchValueRequest(
+                        key=key, value=v0, version=tr._read_version
+                    )
+                    # the RPC send snapshots the active span: install the
+                    # watch root so the storage-side fire parents to it
+                    prev = swap_active_span(root)
+                    try:
+                        reply = await tr._load_balanced(
+                            key, T.WATCH_VALUE, req
+                        )
+                    finally:
+                        swap_active_span(prev)
+                    if not out.is_ready():
+                        out._set(reply.value)
+                    if root is not None:
+                        emit_span("Client.watch", "client", root, t0, now())
+                    return
+                except (FdbError, BrokenPromise):
+                    await delay(0.1)
+                except Cancelled:
+                    raise  # handled by the outer except (cancel contract)
+                except Exception as e:
+                    if not out.is_ready():
+                        out._set_error(e)
+                    return
+        except Cancelled:
+            # transaction reset/destroy cancels its watches: resolve the
+            # caller-visible future with the non-retryable error (the
+            # reference's watch lifetime contract), then let the runtime
+            # see the cancellation
+            if not out.is_ready():
+                out._set_error(TransactionCancelled())
+            raise  # actor-cancelled-swallow
 
     # -- transactions ----------------------------------------------------------
 

@@ -74,6 +74,10 @@ class Transaction:
         self._wcr: list[tuple[bytes, bytes]] = []
         self._unreadable: set[bytes] = set()  # versionstamped-key placeholders
         self._watches: list[tuple[bytes, object]] = []  # (key, Future)
+        # watch actors this transaction started (commit's _start_watches):
+        # reset()/on_error() cancels them — FDB's watch lifetime contract
+        # (outstanding watches die with the transaction that owns them)
+        self._watch_actors: list = []
         self.committed_version: Optional[int] = None
         self.versionstamp: Optional[bytes] = None
         # transaction-debug attach id (fdb_transaction_set_option
@@ -554,7 +558,16 @@ class Transaction:
 
     async def commit(self) -> int:
         if not self._mutations and not self._wcr:
-            # read-only: committing at the read version with no writes
+            # read-only: committing at the read version with no writes.
+            # A watch-only transaction (watch() with no reads) must still
+            # anchor its baseline at a version ordered BEFORE anything
+            # the caller commits after us — without it the watch actor
+            # reads the baseline at a fresh version and a change landing
+            # in between is silently adopted as the baseline: a permanent
+            # lost wakeup (seed-5 chaos soak, clogged registration racing
+            # the release commit).
+            if self._watches and self._read_version is None:
+                await self.get_read_version()
             self.committed_version = self._read_version or 0
             self._start_watches()
             return self.committed_version
@@ -610,18 +623,29 @@ class Transaction:
                 # collapsed over a SNAPSHOT read whose base may have moved
                 # without conflicting ("value_db"), or a versionstamped
                 # value) — read the baseline back at the commit version
-                self.db.client.spawn(
-                    self.db._watch_actor(
-                        key, fut, baseline_version=self.committed_version
+                self._watch_actors.append(
+                    self.db.client.spawn(
+                        self.db._watch_actor(
+                            key, fut, baseline_version=self.committed_version
+                        )
                     )
                 )
                 continue
-            self.db.client.spawn(
-                self.db._watch_actor(
-                    key,
-                    fut,
-                    baseline_version=self._read_version,
-                    baseline_value=baseline_value,
+            # a write-only transaction watching a key it didn't write has
+            # no read version; its serialization point is the commit
+            # version, so the baseline anchors there — never at a fresh
+            # version, which would adopt a racing change (lost wakeup)
+            bv = self._read_version
+            if bv is None:
+                bv = self.committed_version or None
+            self._watch_actors.append(
+                self.db.client.spawn(
+                    self.db._watch_actor(
+                        key,
+                        fut,
+                        baseline_version=bv,
+                        baseline_value=baseline_value,
+                    )
                 )
             )
         self._watches = []
@@ -632,9 +656,26 @@ class Transaction:
 
     # -- retry loop ------------------------------------------------------------
 
+    def cancel_watches(self) -> None:
+        """Cancel this transaction's outstanding watches (the reference's
+        watch lifetime: reset/destroy cancels them). Futures not yet
+        handed to an actor (watch() before commit) and futures whose
+        actor is parked server-side both resolve promptly with the
+        non-retryable TransactionCancelled."""
+        from ..errors import TransactionCancelled
+
+        for _key, fut in self._watches:
+            if not fut.is_ready():
+                fut._set_error(TransactionCancelled())
+        self._watches = []
+        for actor in self._watch_actors:
+            actor.cancel()
+        self._watch_actors = []
+
     def reset(self) -> None:
         backoff = getattr(self, "_backoff", 0.0)
         priority, tenant = self.priority, self.tenant
+        self.cancel_watches()
         self.__init__(self.db)
         self._backoff = backoff
         # admission options survive reset: a throttled-then-retried txn

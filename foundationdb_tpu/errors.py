@@ -65,5 +65,20 @@ class AccessedUnreadable(FdbError):
     (error 1036)."""
 
 
+class TooManyWatches(FdbError):
+    """Storage server is at its STORAGE_WATCH_LIMIT (error 1032
+    too_many_watches). Retryable: the client backs off and re-registers —
+    parked watches fire and drain continuously, so capacity returns."""
+
+    retryable = True
+
+
+class TransactionCancelled(FdbError):
+    """Operation belonged to a transaction that was cancelled or reset
+    (error 1025 transaction_cancelled). NOT retryable: the watch/future
+    was deliberately abandoned by its owner; retrying would resurrect
+    work the application explicitly discarded."""
+
+
 class DatabaseShutdown(FdbError):
     pass

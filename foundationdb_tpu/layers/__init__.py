@@ -7,3 +7,4 @@ directory_impl.py)."""
 from .tuple import pack, unpack, range_of  # noqa: F401
 from .subspace import Subspace  # noqa: F401
 from .directory import DirectoryLayer  # noqa: F401
+from .pubsub import Topic  # noqa: F401

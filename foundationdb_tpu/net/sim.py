@@ -136,6 +136,13 @@ class Sim:
         from .metrics import TransportMetrics
 
         self.transport_metrics = TransportMetrics("sim")
+        # modeled wire frames: sends to the same destination within one
+        # event-loop tick would share a super-frame on the real transport
+        # (gen-7 frame batching), so they share one modeled frame here —
+        # makes messagesPerFrame meaningful on sim benches (the watch-storm
+        # "one super-frame per connection" evidence) without a real wire.
+        # {(src, dst) → (tick, messages_in_open_frame)}
+        self._open_frames: dict = {}
         # transport chaos (ISSUE 14): armed EXPLICITLY with a dedicated
         # rng (tools/soak.py draws it at the very END of its sequence) so
         # the main chaos stream — and every pinned seed riding it — stays
@@ -243,7 +250,7 @@ class Sim:
 
         span_ctx = _trace.active_span()
         reply: Future = Future()
-        self.transport_metrics.messages_sent.add(1)
+        self._count_send(src, ep.address)
         if self._transport_fault_fires():
             # transport-truncate chaos site: this request rode the torn
             # tail of a super-frame — typed retryable failure for THIS
@@ -298,16 +305,59 @@ class Sim:
         self.loop.call_at(self._delivery_time(src, ep.address), deliver)
         return reply
 
+    def _count_send(self, src: str, dst: str) -> None:
+        """Message + modeled-frame accounting for one sim send. Same-tick
+        sends to the same destination share one frame when frame batching
+        is on (what the real transport's flush coalescing does to a
+        fan-out burst); each flush's depth feeds messagesPerFlush."""
+        m = self.transport_metrics
+        m.messages_sent.add(1)
+        if not getattr(self.knobs, "TRANSPORT_FRAME_BATCHING", True):
+            m.frames_sent.add(1)
+            m.frames_received.add(1)
+            m.messages_per_flush.add(1.0)
+            return
+        t = self.loop.now()
+        key = (src, dst)
+        open_frame = self._open_frames.get(key)
+        if open_frame is not None and open_frame[0] == t:
+            self._open_frames[key] = (t, open_frame[1] + 1)
+            return
+        if open_frame is not None:
+            m.messages_per_flush.add(float(open_frame[1]))
+        if len(self._open_frames) > 4096:
+            # stale open frames from dead pairs: flush everything not on
+            # the current tick (their frames were already counted)
+            for k, (tk, n) in list(self._open_frames.items()):
+                if tk != t:
+                    m.messages_per_flush.add(float(n))
+                    del self._open_frames[k]
+        self._open_frames[key] = (t, 1)
+        m.frames_sent.add(1)
+        m.frames_received.add(1)
+
     def _reply_ok(self, src: str, dst: str, reply: Future, value) -> None:
         if not self._deliverable(src, dst):
             return
-        self.loop.call_at(self._delivery_time(src, dst), lambda: reply._set(value))
+        self._count_send(src, dst)
+        self.loop.call_at(
+            self._delivery_time(src, dst),
+            lambda: (
+                self.transport_metrics.messages_received.add(1),
+                reply._set(value),
+            ),
+        )
 
     def _reply_err(self, src: str, dst: str, reply: Future, err) -> None:
         if not self._deliverable(src, dst):
             return
+        self._count_send(src, dst)
         self.loop.call_at(
-            self._delivery_time(src, dst), lambda: reply._set_error(err)
+            self._delivery_time(src, dst),
+            lambda: (
+                self.transport_metrics.messages_received.add(1),
+                reply._set_error(err),
+            ),
         )
 
     # -- fault injection (ISimulator analog) ----------------------------------

@@ -75,6 +75,19 @@ class Knobs:
     # tip: past this the advance proceeds and the pin goes TOO_OLD (an
     # abandoned pin must not grow the MVCC window without limit)
     STORAGE_PIN_MAX_LAG_VERSIONS = 10_000_000
+    # watches & change feeds (ISSUE 16 / ROADMAP item 6): parked watch
+    # registrations per storage server — past this, registration fails
+    # with the typed retryable TooManyWatches and the client backs off
+    # (reference: MAX_STORAGE_SERVER_WATCH_BYTES; sized so 100K-watch
+    # storms fit with an order of magnitude to spare)
+    STORAGE_WATCH_LIMIT = 1_000_000
+    # change-feed retention: per-epoch committed diffs are kept for this
+    # many versions behind the tip (~= seconds × VERSIONS_PER_SECOND).
+    # Resuming below the retained floor raises TOO_OLD. Active subscriber
+    # leases hold the floor (like scan-lease pins), bounded at 2x this.
+    STORAGE_FEED_RETENTION_VERSIONS = 5_000_000
+    # entries per change-feed read reply before `more` paging kicks in
+    STORAGE_FEED_BATCH_ENTRIES = 1_000
     # TPU batched-read snapshot index on the storage read path
     # (SURVEY.md's secondary target): serves batch_get misses and
     # getRange bounds, delta-merged each durability epoch. None = AUTO:
@@ -379,6 +392,25 @@ class Knobs:
             self.STORAGE_PIN_MAX_LAG_VERSIONS = rng.random_choice(
                 [6_000_000, 10_000_000, 50_000_000]
             )
+
+    def randomize_watches(self, rng) -> None:
+        """Watch/change-feed knob randomization (ISSUE 16), drawn at the
+        very END of the soak's sequence (after randomize_storage_engine)
+        for the pinned-seed reason shared by every post-PR-12 satellite:
+        earlier cluster-shape and workload-rotation draws must reproduce
+        exactly. Tiny limits force the TooManyWatches backoff path; tiny
+        retention forces feed TOO_OLD resumes."""
+        if rng.coinflip(0.25):
+            # tiny limits force the over-limit error + client backoff
+            self.STORAGE_WATCH_LIMIT = rng.random_choice([4, 64, 1_000_000])
+        if rng.coinflip(0.25):
+            # tiny retention forces feed resume-below-floor TOO_OLD
+            self.STORAGE_FEED_RETENTION_VERSIONS = rng.random_choice(
+                [200_000, 1_000_000, 5_000_000]
+            )
+        if rng.coinflip(0.25):
+            # tiny pages force the `more` continuation path
+            self.STORAGE_FEED_BATCH_ENTRIES = rng.random_choice([2, 64, 1_000])
 
     def randomize_read_pipeline(self, rng) -> None:
         """Read-pipeline knob randomization, kept OUT of randomize():

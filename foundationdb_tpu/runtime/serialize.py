@@ -23,10 +23,15 @@ import struct
 # gen 5: batched read pipeline — storage.multiGet / storage.multiGetRange
 #        endpoints and their MultiGet*Request/Reply shapes (ISSUE 12)
 # gen 6: GRV priority/tenant envelope
-PROTOCOL_VERSION = 0x0FDB00B070010007  # gen-7: super-frame batched framing
-#        (net/wire.py BATCH_BIT frames; receivers accept gen-6-shaped
-#        per-message frames too, but a gen-6 build must not peer with a
-#        gen-7 one — the handshake rejects the mix)
+# gen 7: super-frame batched framing (net/wire.py BATCH_BIT frames;
+#        receivers accept gen-6-shaped per-message frames too, but a
+#        gen-6 build must not peer with a gen-7 one — the handshake
+#        rejects the mix)
+PROTOCOL_VERSION = 0x0FDB00B070010008  # gen-8: watches + change feeds —
+#        storage.feedRead streaming envelope (FeedReadRequest/Reply whole-
+#        version pages riding the super-frame path) and the known_committed
+#        frontier piggybacked on TLogPeekReply; a gen-7 peer would decode
+#        peek replies positionally wrong, so the handshake must reject it
 
 
 class BinaryWriter:

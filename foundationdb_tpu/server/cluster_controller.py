@@ -684,6 +684,18 @@ class ClusterController:
                     default=0,
                 ),
             },
+            # watches + change feeds (ISSUE 16): fan-out evidence.
+            # parked/bytes are CURRENT totals across storages (gauges);
+            # fired/batches ratio is the per-version fan-out batching
+            "watches": {
+                "registered": sq("watchesRegistered"),
+                "fired": sq("watchesFired"),
+                "cancelled": sq("watchesCancelled"),
+                "fanout_batches": sq("watchFanoutBatches"),
+                "feed_entries_streamed": sq("feedEntriesStreamed"),
+                "parked_now": agg("storage", "watchesParked"),
+                "watch_bytes_now": agg("storage", "watchBytes"),
+            },
             "latency_bands": {
                 "grv": band_agg("proxy", "grvLatencyBands"),
                 "commit": band_agg("proxy", "commitLatencyBands"),

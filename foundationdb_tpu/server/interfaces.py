@@ -191,6 +191,9 @@ class TLogPeekReply:
     # [(version, mutations)] with version >= begin, ascending
     messages: list[tuple[Version, list[Mutation]]] = field(default_factory=list)
     end_version: Version = INVALID_VERSION  # data complete through this version
+    # piggybacked proxy-acked committed version (the consumer's committed
+    # frontier: watch firing / change-feed visibility gate, ISSUE 16)
+    known_committed: Version = 0
 
 
 @dataclass
@@ -230,6 +233,32 @@ class WatchValueRequest:
 class WatchValueReply:
     value: Optional[bytes] = None  # the changed value
     version: Version = INVALID_VERSION
+
+
+@dataclass
+class FeedReadRequest:
+    """One change-feed page (ISSUE 16): committed per-version diffs for
+    [begin, end) above from_version. Long-polls while the range is
+    quiet; `sub_id` identifies the subscriber's retention lease (the
+    feed floor holds at its cursor while the lease is live, bounded)."""
+
+    begin: bytes = b""
+    end: bytes = b"\xff"
+    from_version: Version = 0
+    limit: int = 0  # 0 = server default (STORAGE_FEED_BATCH_ENTRIES)
+    sub_id: str = ""
+
+
+@dataclass
+class FeedReadReply:
+    """batches = [(version, [(clear_begin, clear_end)...],
+    [(key, value)...])] — whole versions, clears clipped to the
+    subscribed range, both lists canonically sorted. `more` = page was
+    cut at the limit; resume immediately from next_version."""
+
+    batches: list = field(default_factory=list)
+    next_version: Version = 0
+    more: bool = False
 
 
 @dataclass
@@ -525,6 +554,7 @@ class Tokens:
     GET_SHARD_METRICS = "storage.getShardMetrics"
     GET_SPLIT_KEY = "storage.getSplitKey"
     WATCH_VALUE = "storage.watchValue"
+    FEED_READ = "storage.feedRead"
     BATCH_GET = "storage.batchGet"
     MULTI_GET = "storage.multiGet"
     MULTI_GET_RANGE = "storage.multiGetRange"

@@ -301,6 +301,10 @@ class PeekCursor:
         self.config_var = config_var  # AsyncVar[LogSystemConfig]
         self.consumer = consumer  # pop-frontier class at the tlogs
         self._replica = 0  # failover rotation
+        # highest proxy-acked commit any replica has piggybacked: the
+        # consumer's committed frontier (watch firing / feed visibility
+        # gate — a recovery boundary can never cut below it)
+        self.known_committed = 0
 
     def _generation(self, cfg: LogSystemConfig, begin: int):
         """(TLogSet, clamp_version) owning versions from `begin`."""
@@ -347,6 +351,8 @@ class PeekCursor:
                     continue
                 raise err
             reply = fut.get()
+            if reply.known_committed > self.known_committed:
+                self.known_committed = reply.known_committed
             msgs, end = reply.messages, reply.end_version
             if clamp is not None:
                 msgs = [(v, ms) for v, ms in msgs if v <= clamp]

@@ -28,9 +28,19 @@ from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.loop import Cancelled, now
 from ..runtime.stats import CounterCollection
-from ..runtime.trace import SevInfo, SevWarn, emit_span, span, trace
+from ..runtime.trace import (
+    SevInfo,
+    SevWarn,
+    active_span,
+    emit_span,
+    root_context,
+    span,
+    trace,
+)
 from ..kv.selector import SELECTOR_END
 from .interfaces import (
+    FeedReadReply,
+    FeedReadRequest,
     GetKeyReply,
     GetKeyRequest,
     GetKeyValuesReply,
@@ -49,6 +59,7 @@ from .interfaces import (
     WatchValueRequest,
 )
 from .log_system import PeekCursor
+from .watches import WatchManager
 from .systemdata import (
     KEY_SERVERS_PREFIX,
     PRIVATE_PREFIX,
@@ -175,6 +186,24 @@ class StorageServer:
         self.stats.gauge(
             "windowVersions", lambda: self.version.get() - self.durable_version
         )
+        # watches & change feeds (ISSUE 16): committed-gated trigger
+        # fan-out — the counter names ride flowlint's
+        # role_required_counters manifest like the engine's do
+        self._c_watch_reg = self.stats.counter("watchesRegistered")
+        self._c_watch_fired = self.stats.counter("watchesFired")
+        self._c_watch_cancel = self.stats.counter("watchesCancelled")
+        self._c_feed_entries = self.stats.counter("feedEntriesStreamed")
+        self._c_watch_fanout = self.stats.counter("watchFanoutBatches")
+        self.watches = WatchManager(
+            self.knobs,
+            registered=self._c_watch_reg,
+            fired=self._c_watch_fired,
+            cancelled=self._c_watch_cancel,
+            streamed=self._c_feed_entries,
+            fanout_batches=self._c_watch_fanout,
+        )
+        self.stats.gauge("watchBytes", self.watches.bytes_held)
+        self.stats.gauge("watchesParked", self.watches.parked_count)
 
     # -- snapshot pins (ISSUE 15) ----------------------------------------------
 
@@ -269,6 +298,13 @@ class StorageServer:
                         self._apply(m, version)
             if end > self.version.get():
                 self.version.set(end)
+            # fire watches / open feed visibility up to the committed
+            # frontier the tlogs piggybacked (clamped to what's applied)
+            self.watches.advance_committed(
+                min(self._cursor.known_committed, self.version.get()),
+                now(),
+                self._proc_addr(),
+            )
 
     # -- epoch apply (ISSUE 15: one sorted merge per batch) --------------------
 
@@ -282,6 +318,10 @@ class StorageServer:
         per-mutation path would."""
         entries: dict = {}
         clears: list = []
+        # data clears only (shard-drop clears from _apply_private are NOT
+        # data changes: their watchers fail WrongShardServer there and
+        # the feed must not stream them as committed mutations)
+        watch_clears: list = []
         acc = (entries, clears)
         for m in mutations:
             self._c_mutations.add()
@@ -309,6 +349,7 @@ class StorageServer:
                 entries[m.param1] = m.param2
             elif m.type == MutationType.CLEAR_RANGE:
                 self._epoch_clear(acc, m.param1, m.param2)
+                watch_clears.append((m.param1, m.param2))
             elif m.is_atomic():
                 # None result (compare-and-clear) = point tombstone entry
                 entries[m.param1] = apply_atomic(
@@ -323,6 +364,7 @@ class StorageServer:
             self._l_epoch_size.add(float(len(entries) + len(clears)))
             if self.engine is not None:
                 self._durable_queue.append(("epoch", version, (entries, clears)))
+            self.watches.on_epoch(version, entries, watch_clears, now())
 
     def _epoch_clear(self, acc, begin: bytes, end: bytes) -> None:
         entries, clears = acc
@@ -370,6 +412,9 @@ class StorageServer:
                     To=boundary,
                 )
                 self.data.rollback_after(boundary)
+                # staged watch/feed diffs above the boundary were never
+                # acked: drop them unfired/unstreamed (no phantom to retract)
+                self.watches.rollback_after(boundary)
                 # scan leases above the boundary hold cut-off versions:
                 # drop them (their next chunk re-reads and fails TOO_OLD
                 # or FutureVersion like any reader of a dead version)
@@ -455,14 +500,17 @@ class StorageServer:
                         return  # point mutation: buffered only
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)
+            self.watches.on_epoch(version, {m.param1: m.param2}, (), now())
         elif m.type == MutationType.CLEAR_RANGE:
             self._window_clear(m.param1, m.param2, version)
+            self.watches.on_epoch(version, {}, ((m.param1, m.param2),), now())
         elif m.is_atomic():
             newv = apply_atomic(m.type, self._latest_value(m.param1), m.param2)
             if newv is None:
                 self._window_clear(m.param1, m.param1 + b"\x00", version)
             else:
                 self.data.set(m.param1, newv, version)
+            self.watches.on_epoch(version, {m.param1: newv}, (), now())
         else:
             raise AssertionError(f"storage can't apply {m!r}")
         if self.engine is not None:
@@ -578,6 +626,10 @@ class StorageServer:
             self._fetch_buffers.pop((begin, end), None)
             self._fetch_info.pop((begin, end), None)
             clear_end = end or b"\xff\xff\xff\xff\xff"
+            # parked watches in the dropped range fail over to the new
+            # team NOW — the drop's clear below is not a data change and
+            # must never fire them with value=None
+            self.watches.fail_range(begin, clear_end, WrongShardServer)
             if epoch is not None:
                 # epoch path: the drop's clear is a native range tombstone
                 # in the building epoch (drained to the engine with it)
@@ -1413,20 +1465,67 @@ class StorageServer:
         return out
 
     async def watch_value(self, req: WatchValueRequest) -> WatchValueReply:  # flowlint: disable=reg-endpoint-span — long-poll: a span over a parked watch would read as minutes of latency
-        """Park until the key's value differs from the watcher's belief
-        (watchValue_impl:758). Fires on the version that changed it. The
-        shard moving away surfaces as wrong_shard_server and the client
-        re-registers at the new team."""
+        """Park until the key's COMMITTED value differs from the
+        watcher's belief (watchValue_impl:758): registration is an O(1)
+        WatchManager entry, not a poll loop — the epoch apply path fires
+        it when the committed frontier covers a version that changed the
+        key. The shard moving away surfaces as wrong_shard_server
+        (WatchManager.fail_range) and the client re-registers at the new
+        team; registration past STORAGE_WATCH_LIMIT fails with the
+        retryable TooManyWatches."""
         if buggify():
             await delay(0.002)  # watch registration races a change
         await self._wait_for_version(req.version)
+        self._check_read(req.key, req.key + b"\x00", self.version.get())
+        # immediate check at the newest committed version this server
+        # knows: a change that landed while the registration was in
+        # flight replies now instead of parking a watch that would never
+        # fire. (At or below the client's GRV nothing uncommitted is
+        # visible, so this read can never leak a rollback-doomed value.)
+        at = min(max(self.watches.committed, req.version), self.version.get())
+        known, v = self.data.get_with_presence(req.key, at)
+        if not known and self.engine is not None:
+            v = self.engine.read_value(req.key)
+        if v != req.value:
+            return WatchValueReply(value=v, version=at)
+        # parent the eventual Storage.watchFire span to the TRACE ROOT
+        # (not the client's rpc span): the fire is a sibling root, so
+        # `cli trace breakdown` aggregates its own p50/p99 — the watch
+        # notification latency number — instead of folding it into the
+        # registration rpc's self time
+        ctx = active_span()
+        root = root_context(ctx.trace_id) if ctx is not None else None
+        entry = self.watches.register(req.key, req.value, root)
+        try:
+            value, version = await entry.future
+        finally:
+            # fire already removed it; this covers caller-gone unwinds
+            self.watches.deregister(entry)
+        return WatchValueReply(value=value, version=version)
+
+    async def feed_read(self, req: FeedReadRequest) -> FeedReadReply:  # flowlint: disable=reg-endpoint-span — long-poll: parked until the range has committed changes
+        """One change-feed page: committed per-version diffs for
+        [begin, end) above from_version, whole versions per page, paged
+        with `more` past STORAGE_FEED_BATCH_ENTRIES. Long-polls while the
+        range is quiet; the park cursor advances through verified-empty
+        spans (and refreshes the subscriber's retention lease) so a quiet
+        subscriber neither replays the world on wake nor goes TOO_OLD
+        while parked. Resuming below the retention floor raises
+        TransactionTooOld — the subscriber must re-snapshot."""
+        if buggify():
+            await delay(0.002)
+        from_version = req.from_version
+        limit = req.limit or self.knobs.STORAGE_FEED_BATCH_ENTRIES
         while True:
-            self._check_read(req.key, req.key + b"\x00", self.version.get())
-            known, v = self.data.get_with_presence(req.key, self.version.get())
-            if not known and self.engine is not None:
-                v = self.engine.read_value(req.key)
-            if v != req.value:
-                return WatchValueReply(value=v, version=self.version.get())
+            self._check_read(req.begin, req.end, self.version.get())
+            batches, next_version, more = self.watches.feed_collect(
+                req.begin, req.end, from_version, limit, req.sub_id, now()
+            )
+            if batches or more:
+                return FeedReadReply(
+                    batches=batches, next_version=next_version, more=more
+                )
+            from_version = max(from_version, next_version)
             await self.version.on_change()
 
     def _sampled_range(self, begin: bytes, end: bytes):
@@ -1527,6 +1626,7 @@ class StorageServer:
         process.register(Tokens.GET_SHARD_METRICS, self.get_shard_metrics)
         process.register(Tokens.GET_SPLIT_KEY, self.get_split_key)
         process.register(Tokens.WATCH_VALUE, self.watch_value)
+        process.register(Tokens.FEED_READ, self.feed_read)
         process.register(Tokens.BATCH_GET, self.batch_get)
         process.register(Tokens.MULTI_GET, self.multi_get)
         process.register(Tokens.MULTI_GET_RANGE, self.multi_get_range)

@@ -342,7 +342,11 @@ class TLog:
                         out.append((v, full[req.tag]))
                 else:
                     out.append((v, msgs[req.tag]))
-        return TLogPeekReply(messages=out, end_version=durable)
+        return TLogPeekReply(
+            messages=out,
+            end_version=durable,
+            known_committed=self.known_committed,
+        )
 
     def _popped_for(self, tag: int) -> Version:
         """Effective popped frontier: min over expected consumers."""

@@ -154,6 +154,21 @@ def snapshot(result, platform):
             "stages[%s]: p50=%sms over %s traces  %s"
             % (root, agg.get("p50_ms"), agg.get("traces"), top)
         )
+    # transport provenance (perf embeds the world's TransportMetrics
+    # snapshot): message/frame totals and the coalescing ratio next to
+    # the number, so a frame-batching regression is visible in the JSON
+    tr = entry.get("transport") or {}
+    if tr:
+        log(
+            "transport: msgs=%s frames=%s (%s msgs/frame) loopback=%s tcp=%s"
+            % (
+                tr.get("messagesSent"),
+                tr.get("framesSent"),
+                tr.get("messagesPerFrame"),
+                tr.get("loopbackMessages"),
+                tr.get("tcpMessages"),
+            )
+        )
     # run-loop profiler provenance (perf embeds the snapshot next to the
     # kernel counters): a capture whose loop spent half its time in host
     # encode or paid SlowTask stalls says so next to its number

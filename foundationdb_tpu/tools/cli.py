@@ -169,6 +169,19 @@ class FdbCli:
                 + (f", oldest {age:.1f}s" if age else "")
                 + ")"
             )
+        wa = (doc.get("workload") or {}).get("watches") or {}
+        if (wa.get("registered") or {}).get("counter") or wa.get("parked_now"):
+            fired = (wa.get("fired") or {}).get("counter") or 0
+            batches = (wa.get("fanout_batches") or {}).get("counter") or 0
+            lines.append(
+                f"Watches: {wa.get('parked_now') or 0} parked "
+                f"({wa.get('watch_bytes_now') or 0} bytes), "
+                f"{(wa.get('registered') or {}).get('counter', 0)} registered, "
+                f"{fired} fired in {batches} fan-out batches, "
+                f"{(wa.get('cancelled') or {}).get('counter', 0)} cancelled, "
+                f"{(wa.get('feed_entries_streamed') or {}).get('counter', 0)} "
+                f"feed entries streamed"
+            )
         tr = (doc.get("transport") or {}).get("total") or {}
         if tr.get("messagesSent"):
             lines.append(

@@ -40,7 +40,14 @@ READ_STAGES = [
     "ClientReadRetry",
     "ClientReadDone",
 ]
-STAGE_ORDER = COMMIT_STAGES + READ_STAGES
+# watch lifecycle spans (ISSUE 16): Client.watch covers register→fire on
+# the client, Storage.watchFire the server-side queue→fire interval — kept
+# after the read stages so the historical prefix stays byte-stable
+WATCH_STAGES = [
+    "Client.watch",
+    "Storage.watchFire",
+]
+STAGE_ORDER = COMMIT_STAGES + READ_STAGES + WATCH_STAGES
 
 # event Types that carry chain stages; chain() reads only the commit
 # stream by default (output stability), full_chain() reads both

@@ -104,6 +104,10 @@ def run_sim(args) -> dict:
     prof = getattr(sim.loop, "profiler", None)
     if prof is not None:
         report["run_loop"] = prof.snapshot(top=5)
+    # transport counters (net/metrics.py): message/frame totals and the
+    # coalescing ratio ride in every BENCH JSON so batching regressions
+    # show up next to the throughput numbers (ISSUE 16 satellite)
+    report["transport"] = sim.transport_metrics.snapshot()
     return report
 
 
@@ -262,6 +266,7 @@ def run_overload(args) -> dict:
     prof = getattr(sim.loop, "profiler", None)
     if prof is not None:
         report["run_loop"] = prof.snapshot(top=5)
+    report["transport"] = sim.transport_metrics.snapshot()
     return report
 
 
@@ -341,6 +346,7 @@ def run_tcp_client(args, coordinators) -> dict:
     prof = getattr(world.loop, "profiler", None)
     if prof is not None:
         report["run_loop"] = prof.snapshot(top=5)
+    report["transport"] = world.transport_metrics.snapshot()
     return report
 
 

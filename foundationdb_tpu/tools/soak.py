@@ -57,6 +57,8 @@ from ..workloads import (
     SerializabilityWorkload,
     SidebandWorkload,
     WatchesWorkload,
+    WatchSemanticsWorkload,
+    WatchStormWorkload,
     run_workloads,
 )
 
@@ -235,6 +237,23 @@ def run_one(
     # leases, the pin-lag cap, and the storage-epoch-stall chaos site
     # (armed through the ordinary buggify machinery) all get exercised
     knobs.randomize_storage_engine(shape_rng)
+    # watch/feed draws (ISSUE 16) are the NEW end of the sequence — the
+    # semantics oracle (zero lost/phantom triggers, feed byte-match) and
+    # the fan-out storm rotate in against whatever chaos the earlier
+    # draws armed (attrition/rollback/movekeys compose for free), and the
+    # watch knob shrink (tiny watch limits / retention floors) draws
+    # after every prior knob so pinned seeds reproduce exactly
+    if shape_rng.coinflip(0.35):
+        workloads.insert(
+            len(workloads) - 1,
+            WatchSemanticsWorkload(db, rng.fork(), actors=2, changes=6),
+        )
+    if shape_rng.coinflip(0.3):
+        workloads.insert(
+            len(workloads) - 1,
+            WatchStormWorkload(db, rng.fork(), watchers=48, keys=6),
+        )
+    knobs.randomize_watches(shape_rng)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
     fired = len(sim.buggify.fired)
@@ -258,6 +277,7 @@ def run_one(
         "buggify_sites": sites,
         "kernel_faults_armed": bool(knobs.CONFLICT_FAULT_INJECTION),
         "overload_armed": bool(overload),
+        "workloads": [type(w).__name__ for w in workloads],
         "config": cfg.as_dict(),
     }
 
@@ -382,6 +402,111 @@ def mixed_soak(
     return out
 
 
+def watch_storm(
+    watchers: int = 100_000,
+    keys: int = 1_000,
+    seed: int = 0,
+    verbose: bool = False,
+) -> dict:
+    """The ISSUE 16 fan-out acceptance run: park ``watchers`` watches
+    across ``keys`` keys from one client, read the parked-memory gauges
+    off the status document, release every key, and require every watch
+    to fire in version order. Evidence captured:
+
+    - bounded memory: workload.watches parked_now/watch_bytes_now while
+      fully parked (bytes/watch must stay O(key+value), not O(clients));
+    - fan-out batching: watchesFired vs watchFanoutBatches (whole
+      versions fire as one batch) and the transport messagesPerFrame
+      ratio (same-tick replies to one client share super-frames);
+    - notification latency: Client.watch / Storage.watchFire span p50/p99
+      via tools/trace_analyze.critical_path on the sampled traces.
+
+    Run: python -m foundationdb_tpu.tools.soak --watch-storm [n] [seed]
+    """
+    from ..client import management
+    from ..runtime.futures import wait_for_all
+    from ..runtime.trace import trace_log
+    from . import trace_analyze as ta
+
+    # sample ~1k watch lifecycles: enough traces for a p99 without the
+    # trace log dwarfing the run
+    knobs = Knobs(TRACE_SAMPLE_RATE=min(1.0, 1000.0 / max(watchers, 1)))
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_proxies=1, n_tlogs=1, n_storage=2)
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    out: dict = {"watchers": watchers, "keys": keys, "seed": seed}
+
+    def key(j: int) -> bytes:
+        return b"storm/k%06d" % (j % keys)
+
+    async def go():
+        futs = []
+        # register in batches: one transaction per 5k watchers (a single
+        # 100k-watch txn would park the whole registration burst behind
+        # one commit)
+        for lo in range(0, watchers, 5000):
+            hi = min(lo + 5000, watchers)
+
+            async def park(tr, lo=lo, hi=hi):
+                return [tr.watch(key(j)) for j in range(lo, hi)]
+
+            futs.extend(await db.run(park))
+        # let the registration actors drain (every future parked
+        # server-side), then read the parked gauges off the status doc
+        from ..runtime.futures import delay
+
+        target = len(futs)
+        while True:
+            await delay(1.0)
+            doc = await management.get_status(cluster.coordinators, db.client)
+            wa = (doc.get("workload") or {}).get("watches") or {}
+            parked = wa.get("parked_now") or 0
+            if parked >= target:
+                break
+        out["parked_now"] = parked
+        out["watch_bytes_now"] = wa.get("watch_bytes_now") or 0
+        out["bytes_per_watch"] = round(out["watch_bytes_now"] / parked, 1)
+
+        async def release(tr):
+            for j in range(keys):
+                tr.set(key(j), b"released")
+
+        await db.run(release)
+        await wait_for_all(futs)
+        vals = {f.get() for f in futs}
+        assert vals == {b"released"}, f"wrong fire values: {vals!r}"
+        doc = await management.get_status(cluster.coordinators, db.client)
+        wa = (doc.get("workload") or {}).get("watches") or {}
+        out["fired"] = (wa.get("fired") or {}).get("counter")
+        out["fanout_batches"] = (wa.get("fanout_batches") or {}).get("counter")
+        out["registered"] = (wa.get("registered") or {}).get("counter")
+        return True
+
+    assert sim.run_until_done(spawn(go()), 7200.0)
+    tm = sim.transport_metrics.snapshot()
+    out["transport"] = {
+        k: tm.get(k)
+        for k in ("messagesSent", "framesSent", "messagesPerFrame")
+    }
+    cp = ta.critical_path(trace_log().events)
+    for name in ("Client.watch", "Storage.watchFire"):
+        agg = cp.get(name)
+        if agg:
+            out[name] = {
+                "traces": agg["traces"],
+                "p50_ms": agg["p50_ms"],
+                "p99_ms": agg["p99_ms"],
+            }
+    if verbose:
+        import json
+
+        print(json.dumps(out, default=str, indent=1))
+    return out
+
+
 def buggify_site_names(fired) -> list:
     """Human-readable fired-site names for the coverage report: code sites
     render as `file.py:line`, named sites (the kernel-fault injector's)
@@ -408,6 +533,11 @@ def main(argv=None) -> int:
         thirds = [p for p in out["read_p95_by_third"] if p is not None]
         # flatness gate: the last third must not run away from the first
         return 0 if (len(thirds) < 2 or thirds[-1] <= 3 * thirds[0]) else 1
+    if argv and argv[0] == "--watch-storm":
+        watchers = int(argv[1]) if len(argv) > 1 else 100_000
+        seed = int(argv[2]) if len(argv) > 2 else 0
+        out = watch_storm(watchers=watchers, seed=seed, verbose=True)
+        return 0 if out.get("fired") else 1
     n = int(argv[0]) if argv else 20
     first = int(argv[1]) if len(argv) > 1 else 0
     failures = []

@@ -63,3 +63,7 @@ from .chaos_extra import (  # noqa: E402,F401
 )
 from .kernel_chaos import KernelChaosWorkload  # noqa: E402,F401
 from .overload import OverloadBurstWorkload  # noqa: E402,F401
+from .watch_semantics import (  # noqa: E402,F401
+    WatchSemanticsWorkload,
+    WatchStormWorkload,
+)

@@ -1,0 +1,533 @@
+"""Watches and change feeds (ISSUE 16).
+
+The notification subsystem end to end: WatchManager staging/committed-
+frontier gating at the unit level; the client cancel/Cancelled discipline
+(reset cancels outstanding watches promptly, storage death surfaces
+BrokenPromise to the re-registration loop instead of wedging, failover
+re-registration never double-fires a future); change-feed streaming,
+resume and the retention-floor TOO_OLD; the status/cli surface; and the
+pub/sub layer built on both.
+"""
+
+import pytest
+
+from foundationdb_tpu.client import Database
+from foundationdb_tpu.errors import (
+    TooManyWatches,
+    TransactionCancelled,
+    TransactionTooOld,
+    WrongShardServer,
+)
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.futures import delay, spawn, timeout
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.server import Cluster, ClusterConfig
+
+
+def make_db(seed=0, knobs=None, **cfg):
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(**cfg))
+    db = Database(sim, cluster.proxy_addrs)
+    return sim, cluster, db
+
+
+def drive(sim, coro, limit=120.0):
+    return sim.run_until_done(spawn(coro), limit)
+
+
+# -- WatchManager unit: staging, committed gating, limits, rollback -----------
+
+
+def _manager(knobs=None):
+    from foundationdb_tpu.runtime.stats import CounterCollection
+    from foundationdb_tpu.server.watches import WatchManager
+
+    c = CounterCollection("t", "t")
+    return WatchManager(
+        knobs or Knobs(),
+        registered=c.counter("r"),
+        fired=c.counter("f"),
+        cancelled=c.counter("c"),
+        streamed=c.counter("s"),
+        fanout_batches=c.counter("b"),
+    )
+
+
+def test_watch_fires_only_past_committed_frontier():
+    """An applied-but-uncommitted epoch must not fire: triggers wait for
+    the known-committed frontier (the zero-phantom invariant)."""
+    wm = _manager()
+    e = wm.register(b"k", None)
+    wm.on_epoch(100, {b"k": b"v1"}, (), 0.0)
+    assert not e.future.is_ready()
+    wm.advance_committed(99, 0.0)
+    assert not e.future.is_ready()  # frontier still below the epoch
+    wm.advance_committed(100, 0.0)
+    assert e.future.is_ready()
+    assert e.future.get() == (b"v1", 100)
+    assert wm.parked_count() == 0
+
+
+def test_rollback_drops_staged_epoch_without_firing():
+    """A recovery rollback truncates staged (uncommitted) epochs: the
+    watch they would have triggered never fires with rolled-back data."""
+    wm = _manager()
+    e = wm.register(b"k", None)
+    wm.on_epoch(100, {b"k": b"ghost"}, (), 0.0)
+    wm.rollback_after(50)
+    wm.advance_committed(200, 0.0)
+    assert not e.future.is_ready()  # the ghost write never committed
+    # the NEXT committed change fires normally
+    wm.on_epoch(300, {b"k": b"real"}, (), 0.0)
+    wm.advance_committed(300, 0.0)
+    assert e.future.get() == (b"real", 300)
+
+
+def test_watches_fire_in_version_order_one_fanout_batch():
+    """Several staged epochs covered by one frontier advance fire in
+    version order and count one fan-out batch."""
+    wm = _manager()
+    entries = [wm.register(b"k%d" % i, None) for i in range(3)]
+    for i, v in enumerate((10, 20, 30)):
+        wm.on_epoch(v, {b"k%d" % i: b"x"}, (), 0.0)
+    wm.advance_committed(30, 0.0)
+    versions = [e.future.get()[1] for e in entries]
+    assert versions == [10, 20, 30]
+    assert wm._c_fanout.value == 1
+
+
+def test_clear_range_fires_none_and_same_value_does_not_fire():
+    wm = _manager()
+    ea = wm.register(b"a", b"old")
+    eb = wm.register(b"b", b"same")
+    wm.on_epoch(10, {b"b": b"same"}, ((b"a", b"a\x00"),), 0.0)
+    wm.advance_committed(10, 0.0)
+    assert ea.future.get() == (None, 10)  # cleared → fires with None
+    assert not eb.future.is_ready()  # unchanged value → no fire
+
+
+def test_watch_limit_raises_typed_retryable():
+    knobs = Knobs(STORAGE_WATCH_LIMIT=2)
+    wm = _manager(knobs)
+    wm.register(b"a", None)
+    wm.register(b"b", None)
+    with pytest.raises(TooManyWatches) as ei:
+        wm.register(b"c", None)
+    assert ei.value.retryable
+    assert wm.bytes_held() > 0
+
+
+def test_watch_bytes_gauge_tracks_registration_lifecycle():
+    wm = _manager()
+    e1 = wm.register(b"key1", b"value-bytes")
+    held = wm.bytes_held()
+    assert held >= len(b"key1") + len(b"value-bytes")
+    wm.deregister(e1)
+    assert wm.bytes_held() == 0 and wm.parked_count() == 0
+    assert wm._c_cancelled.value == 1  # unfired deregister is a cancel
+
+
+def test_fail_range_on_shard_drop():
+    """A shard drop fails its parked watches with WrongShardServer (so
+    holders re-locate) — it must NOT fire them as a data clear."""
+    wm = _manager()
+    e = wm.register(b"m", b"v")
+    out = wm.register(b"z", b"v")  # outside the dropped range
+    wm.fail_range(b"a", b"n", WrongShardServer)
+    with pytest.raises(WrongShardServer):
+        e.future.get()
+    assert not out.future.is_ready()
+    assert wm.parked_count() == 1
+
+
+def test_feed_collect_pages_whole_versions_and_resumes():
+    wm = _manager()
+    wm.on_epoch(10, {b"a": b"1", b"b": b"2"}, (), 0.0)
+    wm.on_epoch(20, {b"a": b"3"}, ((b"b", b"c"),), 0.0)
+    wm.advance_committed(20, 0.0)
+    batches, nv, more = wm.feed_collect(b"", b"\xff", 0, 100, "s1", 0.0)
+    assert [b[0] for b in batches] == [10, 20]
+    assert batches[0][2] == [(b"a", b"1"), (b"b", b"2")]
+    assert batches[1][1] == [(b"b", b"c")] and batches[1][2] == [(b"a", b"3")]
+    assert nv == 20 and not more
+    # resume from mid-stream: only the later version
+    batches, _, _ = wm.feed_collect(b"", b"\xff", 10, 100, "s1", 0.0)
+    assert [b[0] for b in batches] == [20]
+    # tiny page limit: whole versions still never split
+    batches, nv, more = wm.feed_collect(b"", b"\xff", 0, 1, "s1", 0.0)
+    assert [b[0] for b in batches] == [10] and more and nv == 10
+
+
+def test_feed_too_old_below_retention_floor():
+    knobs = Knobs(STORAGE_FEED_RETENTION_VERSIONS=100)
+    wm = _manager(knobs)
+    wm.on_epoch(10, {b"a": b"1"}, (), 0.0)
+    wm.advance_committed(10, 0.0)
+    wm.advance_committed(1000, 0.0)  # floor = 1000 - 100 = 900
+    with pytest.raises(TransactionTooOld):
+        wm.feed_collect(b"", b"\xff", 10, 100, "", 0.0)
+
+
+def test_feed_lease_holds_floor_but_is_capped():
+    """An active subscriber's cursor pins the retention floor; an
+    abandoned one cannot hold it past 2x retention."""
+    knobs = Knobs(STORAGE_FEED_RETENTION_VERSIONS=100)
+    wm = _manager(knobs)
+    wm.on_epoch(10, {b"a": b"1"}, (), 0.0)
+    wm.advance_committed(10, 0.0)
+    # subscriber parked at version 10 with a live lease
+    wm.feed_collect(b"", b"\xff", 0, 100, "slow", now := 0.0)
+    wm.advance_committed(150, now)  # plain retention would floor at 50
+    assert wm._floor <= 10  # lease held it
+    wm.advance_committed(500, now)  # 2x-retention cap: 500-200=300 > 10
+    assert wm._floor == 300  # abandoned subscriber cannot wedge memory
+
+
+# -- client cancel / Cancelled discipline -------------------------------------
+
+
+def test_reset_cancels_precommit_watch_future():
+    """watch() before commit, then reset: the future errors promptly with
+    the non-retryable TransactionCancelled (fdb's watch lifetime)."""
+    sim, cluster, db = make_db()
+
+    async def body():
+        tr = db.transaction()
+        fut = tr.watch(b"never")
+        tr.reset()
+        with pytest.raises(TransactionCancelled) as ei:
+            fut.get()
+        assert not ei.value.retryable
+        return True
+
+    assert drive(sim, body())
+
+
+def test_reset_cancels_parked_postcommit_watch():
+    """A committed watch parked server-side dies with the transaction
+    that owns it: reset() cancels the actor and the future errors with
+    TransactionCancelled PROMPTLY (no waiting out the park). The server
+    slot is abandoned, not leaked: like the reference, it drains when
+    the key next changes (fire into the void), and the cancelled future
+    is never overwritten by that late fire."""
+    sim, cluster, db = make_db()
+
+    async def body():
+        tr = db.transaction()
+        tr.set(b"k", b"v0")
+        fut = tr.watch(b"k")
+        await tr.commit()
+        await delay(1.0)  # actor registers and parks server-side
+        ss = cluster.storages[0]
+        assert ss.watches.parked_count() == 1
+        tr.reset()
+        await delay(0.001)  # one tick: cancel delivery, not a park wait
+        with pytest.raises(TransactionCancelled):
+            fut.get()  # errored at reset time, not after a park
+
+        async def change(t):
+            t.set(b"k", b"v1")
+
+        await db.run(change)
+        await delay(1.0)
+        assert ss.watches.parked_count() == 0  # abandoned slot drained
+        with pytest.raises(TransactionCancelled):
+            fut.get()  # the late fire never resurrects the future
+        return True
+
+    assert drive(sim, body())
+
+
+def test_watch_only_txn_anchors_baseline_no_lost_wakeup():
+    """The seed-5 chaos-soak find: a watch-only transaction has no read
+    version, and reading the baseline at a FRESH version silently adopts
+    a change that lands between commit and registration — a permanent
+    lost wakeup. The commit must anchor a GRV for its watches: a change
+    racing the (clogged) registration still fires."""
+    sim, cluster, db = make_db()
+
+    async def body():
+        tr = db.transaction()
+        fut = tr.watch(b"race")  # no reads, no writes: watch-only
+        await tr.commit()  # anchors the baseline GRV
+        # delay the watch actor's baseline read + registration past the
+        # racing change: clog the client<->storage link only (the change
+        # commits through proxy/tlog, which stay clear)
+        ss_addr = cluster.storages[0].process.address
+        sim.clog_pair("client", ss_addr, 2.0)
+
+        async def change(t):
+            t.set(b"race", b"landed")
+
+        await db.run(change)
+        assert not fut.is_ready()  # registration still clogged out
+        got = await timeout(fut, 60.0, default=b"LOST")
+        assert got == b"landed"
+        return True
+
+    assert drive(sim, body())
+
+
+def test_db_run_watch_survives_and_fires_after_success():
+    """db.run does NOT cancel watches on success: the returned future
+    outlives the retry loop and fires on the next change."""
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def register(tr):
+            tr.set(b"wk", b"v0")
+            return tr.watch(b"wk")
+
+        fut = await db.run(register)
+        await delay(0.5)
+        assert not fut.is_ready()
+
+        async def change(tr):
+            tr.set(b"wk", b"v1")
+
+        await db.run(change)
+        assert await timeout(fut, 60.0, default=b"LOST") == b"v1"
+        return True
+
+    assert drive(sim, body())
+
+
+def test_storage_death_brokenpromise_reregisters_no_duplicate_fire():
+    """Kill the storage holding a parked watch: the parked RPC breaks
+    (BrokenPromise), the client loop re-registers on the surviving
+    replica at the original baseline, and the eventual change fires the
+    future EXACTLY once with the committed value."""
+    sim, cluster, db = make_db(replication=2, n_storage=2)
+
+    async def body():
+        async def register(tr):
+            tr.set(b"fk", b"v0")
+            return tr.watch(b"fk")
+
+        fut = await db.run(register)
+        await delay(1.0)
+        parked = [s for s in cluster.storages if s.watches.parked_count()]
+        assert parked, "watch never parked"
+        sim.kill_process(parked[0].process.address)
+        await delay(1.0)
+        assert not fut.is_ready()  # death alone must not fire/err it
+
+        async def change(tr):
+            tr.set(b"fk", b"v1")
+
+        await db.run(change)
+        assert await timeout(fut, 60.0, default=b"LOST") == b"v1"
+        # duplicate-fire suppression is structural (Future sets once);
+        # give any straggler re-registration time to misbehave
+        await delay(2.0)
+        assert fut.get() == b"v1"
+        return True
+
+    assert drive(sim, body(), 300.0)
+
+
+# -- change feed end to end ----------------------------------------------------
+
+
+def test_change_feed_streams_and_resumes():
+    sim, cluster, db = make_db()
+
+    async def body():
+        async def w1(tr):
+            tr.set(b"f/a", b"1")
+            tr.set(b"f/b", b"2")
+
+        async def w2(tr):
+            tr.clear(b"f/a")
+            tr.set(b"f/c", b"3")
+
+        await db.run(w1)
+        await db.run(w2)
+        feed = db.change_feed(b"f/", b"f0", from_version=0)
+        events = []
+        versions = []
+        while len(events) < 4:
+            for b in await feed.next_batches():
+                versions.append(b.version)
+                events.extend(("clear", c) for c in b.clears)
+                events.extend(("set", s) for s in b.sets)
+        assert versions == sorted(versions)
+        assert ("set", (b"f/a", b"1")) in events
+        assert ("set", (b"f/c", b"3")) in events
+        assert any(k == "clear" and c[0] <= b"f/a" < c[1] for k, c in events)
+        # replaying the feed reproduces the range
+        state = {}
+        feed2 = db.change_feed(b"f/", b"f0", from_version=0)
+        got = 0
+        while got < 4:
+            for b in await feed2.next_batches():
+                for cb, ce in b.clears:
+                    for k in [k for k in state if cb <= k < ce]:
+                        del state[k]
+                for k, v in b.sets:
+                    state[k] = v
+                    got += 1
+                got += len(b.clears)
+        async def read(tr):
+            return await tr.get_range(b"f/", b"f0")
+
+        assert sorted(state.items()) == sorted(await db.run(read))
+        # resume from the first feed's cursor: nothing new yet
+        feed3 = db.change_feed(b"f/", b"f0", from_version=feed.version)
+        nxt = spawn(feed3.next_batches())
+        await delay(0.5)
+        assert not nxt.is_ready()  # parked, not replaying history
+
+        async def w3(tr):
+            tr.set(b"f/d", b"4")
+
+        await db.run(w3)
+        batches = await timeout(nxt, 60.0, default=None)
+        assert batches and batches[-1].sets == [(b"f/d", b"4")]
+        return True
+
+    assert drive(sim, body(), 300.0)
+
+
+def test_change_feed_too_old_surfaces_to_client():
+    knobs = Knobs(STORAGE_FEED_RETENTION_VERSIONS=1000)
+    sim, cluster, db = make_db(knobs=knobs)
+
+    async def body():
+        async def w(tr):
+            tr.set(b"t/a", b"1")
+
+        await db.run(w)
+        # let the committed frontier run far past retention
+        await delay(3.0)
+        feed = db.change_feed(b"t/", b"t0", from_version=1)
+        with pytest.raises(TransactionTooOld):
+            await feed.next_batches()
+        return True
+
+    assert drive(sim, body())
+
+
+# -- surface: status doc, cli line, flowlint pin ------------------------------
+
+
+def test_status_and_cli_surface_watches():
+    """Counters flow storage.metrics → status workload.watches → the
+    `cli status` "Watches:" line."""
+    from foundationdb_tpu.client import management
+    from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+    from foundationdb_tpu.tools.cli import FdbCli
+
+    sim = Sim(seed=3)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_storage=1, n_tlogs=1, n_proxies=1)
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    cli = FdbCli(db, cluster.coordinators)
+
+    async def go():
+        async def register(tr):
+            return [tr.watch(b"st/%d" % i) for i in range(5)]
+
+        futs = await db.run(register)
+
+        async def release(tr):
+            for i in range(5):
+                tr.set(b"st/%d" % i, b"go")
+
+        await db.run(release)
+        for f in futs:
+            await timeout(f, 60.0)
+        await delay(6.0)  # metrics poll interval
+        doc = await management.get_status(cluster.coordinators, db.client)
+        text = await cli.execute("status")
+        return doc, text
+
+    doc, text = sim.run_until_done(spawn(go()), 600.0)
+    wa = doc["workload"]["watches"]
+    assert wa["registered"]["counter"] >= 5
+    assert wa["fired"]["counter"] >= 5
+    assert wa["fanout_batches"]["counter"] >= 1
+    assert "Watches:" in text, text
+    assert "fan-out batches" in text
+
+
+def test_flowlint_pins_watch_counters():
+    """Dropping a watch counter the config pins must flag
+    reg-role-metrics — the watches status/cli surface cannot silently go
+    dark (ISSUE 16 satellite)."""
+    from foundationdb_tpu.tools.flowlint import lint, load_config
+
+    config = load_config()
+    pinned = set(config["role_required_counters"]["storage"])
+    assert {
+        "watchesRegistered",
+        "watchesFired",
+        "watchesCancelled",
+        "watchFanoutBatches",
+        "feedEntriesStreamed",
+        "watchesParked",
+        "watchBytes",
+    } <= pinned
+    config["role_required_counters"] = {"storage": ["watchesMissingCtr"]}
+    result = lint(config=config)
+    hits = [
+        f
+        for f in result.failing
+        if f.rule == "reg-role-metrics" and "watchesMissingCtr" in f.detail
+    ]
+    assert hits, "missing required watch counter did not flag"
+
+
+# -- pub/sub layer -------------------------------------------------------------
+
+
+def test_pubsub_topic_watch_wake_and_feed_tail():
+    from foundationdb_tpu.layers import Subspace, Topic
+
+    sim, cluster, db = make_db()
+    topic = Topic(Subspace(("ps",)), "news")
+
+    async def body():
+        # a parked watch-subscriber wakes on publish
+        waiter = spawn(topic.wait_for_messages(db, after_seq=-1))
+        tail = topic.tail(db, from_version=0)
+        await delay(0.5)
+        assert not waiter.is_ready()
+
+        async def pub(tr):
+            await topic.publish(tr, b"hello")
+            await topic.publish(tr, b"world")
+
+        await db.run(pub)
+        msgs = await timeout(waiter, 60.0, default=None)
+        assert msgs == [(0, b"hello"), (1, b"world")]
+        # the feed tailer sees the same messages in publish order
+        tailed = []
+        while len(tailed) < 2:
+            tailed.extend(await tail.next_messages())
+        assert tailed == [(0, b"hello"), (1, b"world")]
+        # a second wait resumes past the consumed cursor and wakes on
+        # the NEXT publish only
+        waiter2 = spawn(topic.wait_for_messages(db, after_seq=1))
+        await delay(0.5)
+        assert not waiter2.is_ready()
+
+        async def pub2(tr):
+            await topic.publish(tr, b"again")
+
+        await db.run(pub2)
+        assert await timeout(waiter2, 60.0, default=None) == [(2, b"again")]
+        return True
+
+    assert drive(sim, body(), 300.0)
+
+
+# -- error taxonomy ------------------------------------------------------------
+
+
+def test_watch_error_types():
+    assert TooManyWatches().retryable
+    assert not TransactionCancelled().retryable
