@@ -563,6 +563,144 @@ def bench_storage_engine():
     }))
 
 
+def bench_prefilter():
+    """BENCH_COMPONENT=prefilter: the proxy conflict pre-filter contention
+    sweep (ISSUE 17). Same-seed sim-cluster A/B (PROXY_CONFLICT_PREFILTER
+    on vs off) at three contention levels — a hot-keyspace readwrite mix
+    whose abort rate climbs as the keyspace shrinks. Per leg: wall time,
+    committed/conflicted/prefiltered counters, workload.abort_rate, the
+    resolver-side transaction count (the work the filter exists to
+    shed), and the resolve/commit latency-band counts. The uplift claim
+    is resolver-side: at the high-contention shape the ON leg must show
+    workload.prefiltered > 0 and fewer transactions reaching resolvers
+    for the same offered load, with resolver band counts dropping at
+    equal commit bands. Writes BENCH_r11.json."""
+    import time as _time
+
+    from foundationdb_tpu.client import management
+    from foundationdb_tpu.client.database import Database
+    from foundationdb_tpu.net.sim import Endpoint, Sim
+    from foundationdb_tpu.runtime.futures import spawn
+    from foundationdb_tpu.runtime.knobs import Knobs
+    from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+    from foundationdb_tpu.workloads import run_workloads
+    from foundationdb_tpu.workloads.readwrite import ReadWriteWorkload
+
+    actors = int(os.environ.get("BENCH_PF_ACTORS", "12"))
+    txns = int(os.environ.get("BENCH_PF_TXNS", "40"))
+    seed = int(os.environ.get("BENCH_PF_SEED", "17"))
+    # keyspace sizes: 8 keys = pathological contention, 64 = hot,
+    # 4096 = the low-contention control (filter should do ~nothing)
+    keyspaces = [
+        int(k) for k in os.environ.get("BENCH_PF_KEYSPACES", "8,64,4096").split(",")
+    ]
+
+    def leg(keyspace, prefilter_on):
+        knobs = Knobs(PROXY_CONFLICT_PREFILTER=prefilter_on)
+        sim = Sim(seed=seed, knobs=knobs)
+        sim.activate()
+        cluster = DynamicCluster(
+            sim,
+            ClusterConfig(n_proxies=2, n_resolvers=2, n_tlogs=1, n_storage=2),
+        )
+        db = Database.from_coordinators(sim, cluster.coordinators)
+        wl = ReadWriteWorkload(
+            db, sim.loop.random.fork(), actors=actors, txns_per_actor=txns,
+            reads_per_txn=4, writes_per_txn=2, keyspace=keyspace,
+            prefix=b"pf/",
+        )
+
+        async def body():
+            await run_workloads([wl])
+            doc = await management.get_status(cluster.coordinators, db.client)
+            # resolver-side work: sum the resolvers' transactions counter
+            # straight off every worker's role-metrics endpoint
+            r_txns = 0
+            for addr in list(sim.processes):
+                try:
+                    snaps = await db.client.request(
+                        Endpoint(addr, "worker.metrics"), None
+                    )
+                except Exception:
+                    continue
+                for snap in (snaps or {}).values():
+                    if isinstance(snap, dict) and snap.get("kind") == "resolver":
+                        r_txns += snap.get("transactions", 0)
+            return doc, r_txns
+
+        t0 = _time.perf_counter()
+        doc, resolver_txns = sim.run_until_done(spawn(body()), 1800.0)
+        wall = _time.perf_counter() - t0
+        assert not sim.prefilter_oracle.violations, sim.prefilter_oracle.violations
+        wld = doc.get("workload") or {}
+        txd = wld.get("transactions") or {}
+        bands = wld.get("latency_bands") or {}
+        out = {
+            "keyspace": keyspace,
+            "prefilter": prefilter_on,
+            "wall_s": round(wall, 3),
+            "committed": (txd.get("committed") or {}).get("counter", 0),
+            "conflicted": (txd.get("conflicted") or {}).get("counter", 0),
+            "prefiltered": (wld.get("prefiltered") or {}).get("counter", 0),
+            "abort_rate": wld.get("abort_rate", 0.0),
+            "resolver_txns": resolver_txns,
+            "resolve_band_count": (bands.get("resolve") or {}).get("count", 0),
+            "commit_band_count": (bands.get("commit") or {}).get("count", 0),
+            "oracle_rejections_checked": sim.prefilter_oracle.rejections_checked,
+        }
+        return out
+
+    sweep = []
+    for ks in keyspaces:
+        on = leg(ks, True)
+        off = leg(ks, False)
+        saved = off["resolver_txns"] - on["resolver_txns"]
+        row = {
+            "keyspace": ks,
+            "on": on,
+            "off": off,
+            "resolver_txns_saved": saved,
+            "resolver_txns_saved_frac": round(
+                saved / max(off["resolver_txns"], 1), 4
+            ),
+            "wall_ratio_off_over_on": round(
+                off["wall_s"] / max(on["wall_s"], 1e-9), 2
+            ),
+        }
+        sweep.append(row)
+        log(
+            f"keyspace {ks}: ON prefiltered={on['prefiltered']} "
+            f"abort={on['abort_rate']:.2f} resolver_txns={on['resolver_txns']} "
+            f"vs OFF abort={off['abort_rate']:.2f} "
+            f"resolver_txns={off['resolver_txns']} "
+            f"(saved {row['resolver_txns_saved_frac']:.0%})"
+        )
+
+    hot = sweep[0]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    artifact = {
+        "metric": "prefilter_resolver_txns_saved_frac",
+        "value": hot["resolver_txns_saved_frac"],
+        "unit": "fraction of resolver-side txns shed at hottest keyspace",
+        "vs_baseline": hot["wall_ratio_off_over_on"],
+        "prefiltered_hot": hot["on"]["prefiltered"],
+        "shape": (
+            f"{actors} actors x {txns} txns, keyspaces "
+            + ",".join(str(k) for k in keyspaces)
+        ),
+        "sweep": sweep,
+    }
+    with open(os.path.join(repo, "BENCH_r11.json"), "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    print(json.dumps({
+        k: artifact[k]
+        for k in (
+            "metric", "value", "unit", "vs_baseline", "prefiltered_hot",
+            "shape",
+        )
+    }))
+
+
 def bench_admission():
     """BENCH_COMPONENT=admission: the overload A/B (ISSUE 13). Two legs of
     tools/perf --overload-factor (same seed, same offered load): admission
@@ -1072,6 +1210,9 @@ def main():
     if os.environ.get("BENCH_COMPONENT") == "storage_engine":
         bench_storage_engine()
         return
+    if os.environ.get("BENCH_COMPONENT") == "prefilter":
+        bench_prefilter()
+        return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 
     # the device phase is gated on a probe; size the workload to what we
@@ -1113,6 +1254,27 @@ def main():
         f"boundaries {nat.boundary_count}"
     )
 
+    # 200x2500 is the DEFAULT cross-round comparison shape (ROADMAP
+    # standing guidance: the 40x640 smoke baseline drifts ±18% run to
+    # run, so a vs_baseline quoted from it doesn't compare across
+    # rounds). When the device phase ran a different (shrunk) shape,
+    # still put the full-shape native denominator on record — ~25s on
+    # this host — so the round's numbers can be compared honestly.
+    nat_tps_full = None
+    if f"{BATCHES}x{TXNS}" == "200x2500":
+        nat_tps_full = nat_tps
+    elif os.environ.get("BENCH_SKIP_FULL_NATIVE") != "1":
+        log("computing 200x2500 native reference baseline (comparison shape)")
+        full = make_batches(200, 2500)
+        natf = NativeConflictSet()
+        enc_f = [natf.encode_batch(txs) for txs in full]
+        t0 = time.time()
+        for i, enc in enumerate(enc_f):
+            natf.resolve_encoded(enc, i + WINDOW, i)
+        nat_tps_full = 200 * 2500 / (time.time() - t0)
+        log(f"native 200x2500 reference: {nat_tps_full/1e6:.3f} Mtxn/s")
+        del full, enc_f, natf
+
     # STAGED OUTPUT: the native baseline is on record BEFORE any device
     # work — a device failure below can no longer erase the whole run
     # (the driver keeps the last JSON line; this one stands until the
@@ -1126,6 +1288,9 @@ def main():
                 "vs_baseline": 0.0,
                 "stage": "native_baseline_only",
                 "native_txn_s": round(nat_tps, 1),
+                "native_txn_s_200x2500": (
+                    round(nat_tps_full, 1) if nat_tps_full else None
+                ),
                 "shape": f"{BATCHES}x{TXNS}",
                 "device": platform,
             }
@@ -1144,12 +1309,12 @@ def main():
         return
 
     try:
-        _device_phase(batches, nat_tps, nat_verdicts)
+        _device_phase(batches, nat_tps, nat_verdicts, nat_tps_full)
     except Exception as e:  # staged line above remains the result
         log(f"device phase failed: {e!r}")
 
 
-def _device_phase(batches, nat_tps, nat_verdicts):
+def _device_phase(batches, nat_tps, nat_verdicts, nat_tps_full=None):
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
 
     # ---- TPU kernel (bucket-grid, conflict/grid.py) ----
@@ -1230,8 +1395,12 @@ def _device_phase(batches, nat_tps, nat_verdicts):
                 # guidance: the native smoke-shape baseline swings ±18%,
                 # so a vs_baseline without its native_txn_s is ambiguous)
                 # and the workload shape, pinned to 200x2500 on-chip for
-                # cross-round comparisons
+                # cross-round comparisons; off-chip runs carry the
+                # 200x2500 native reference alongside the same-shape one
                 "native_txn_s": round(nat_tps, 1),
+                "native_txn_s_200x2500": (
+                    round(nat_tps_full, 1) if nat_tps_full else None
+                ),
                 "shape": f"{BATCHES}x{TXNS}",
                 # kernel counter snapshot: occupancy / overflow replays /
                 # transfer bytes ride every capture, so a number whose run

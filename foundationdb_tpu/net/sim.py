@@ -127,9 +127,12 @@ class Sim:
         self._partitioned: set[tuple[str, str]] = set()
         # simulation-only durability oracle (fdbrpc/sim_validation.h:38):
         # acked commit versions vs recovery end versions
-        from ..runtime.validation import DurabilityOracle
+        from ..runtime.validation import DurabilityOracle, PrefilterOracle
 
         self.validation = DurabilityOracle()
+        # differential oracle for the proxy conflict pre-filter
+        # (ISSUE 17): every pre-rejection re-proven conservative
+        self.prefilter_oracle = PrefilterOracle()
         # transport counters (net/metrics.py) — parity with RealWorld so
         # the worker's transport.metrics endpoint answers on both
         # personalities (sim has no frames; messages count per delivery)

@@ -57,6 +57,29 @@ class Knobs:
     # sim-only seeded device-fault injection at the conflict seam
     # (conflict/faults.py): dispatch errors, hangs, device loss, stalls
     CONFLICT_FAULT_INJECTION = False
+    # proxy conflict pre-filter (ISSUE 17, conflict/prefilter.py): a
+    # decaying summary of recently committed write ranges, fed from
+    # feedback piggybacked on resolver replies and consulted BEFORE a
+    # transaction joins a commit batch — a doomed transaction fails with
+    # the normal retryable not_committed without paying the version
+    # grant, resolver codec, or tlog round trip. Strictly conservative
+    # (rejects only on a stored committed range that provably overlaps a
+    # read at a newer version); off = the pre-PR path (one-build A/B)
+    PROXY_CONFLICT_PREFILTER = True
+    # bucket key = first N bytes of a range's begin key (coarse interval
+    # bloom granularity): smaller prefixes alias more writes per bucket
+    # (cheaper, blunter), longer ones spread them out
+    PREFILTER_PREFIX_LEN = 6
+    # exact (begin, end, version) entries kept per bucket; overflow
+    # evicts oldest-first, which only FORGETS conflicts (conservative)
+    PREFILTER_BUCKET_ENTRIES = 32
+    # buckets kept per proxy; overflow evicts the stalest bucket
+    PREFILTER_MAX_BUCKETS = 4096
+    # entries on the wide-range side list (ranges spanning > 1 bucket)
+    PREFILTER_WIDE_RANGES = 128
+    # committed-write ranges a resolver echoes per reply (newest win;
+    # truncation only delays learning — conservative)
+    PREFILTER_FEEDBACK_MAX_RANGES = 512
     # storage
     STORAGE_DURABILITY_LAG = 0.5  # how far behind durable version may trail (s)
     STORAGE_WAIT_VERSION_TIMEOUT = 1.0  # then future_version (client retries)
@@ -411,6 +434,32 @@ class Knobs:
         if rng.coinflip(0.25):
             # tiny pages force the `more` continuation path
             self.STORAGE_FEED_BATCH_ENTRIES = rng.random_choice([2, 64, 1_000])
+
+    def randomize_prefilter(self, rng) -> None:
+        """Prefilter knob randomization (ISSUE 17), drawn at the very END
+        of the soak's sequence (after randomize_watches) for the
+        pinned-seed reason shared by every post-PR-12 satellite: earlier
+        cluster-shape and workload-rotation draws must reproduce exactly.
+        The knob is drawn both ways so the soak matrix covers on AND off;
+        tiny caps force the eviction/decay paths that only forget
+        conflicts (the conservative direction the oracle checks)."""
+        if rng.coinflip(0.4):
+            self.PROXY_CONFLICT_PREFILTER = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            # short prefixes alias unrelated writes into one bucket —
+            # blunter summary, still conservative (exact entry confirm)
+            self.PREFILTER_PREFIX_LEN = rng.random_choice([1, 3, 6])
+        if rng.coinflip(0.25):
+            # tiny caps force bucket-entry eviction + wide-list overflow
+            self.PREFILTER_BUCKET_ENTRIES = rng.random_choice([2, 8, 32])
+            self.PREFILTER_WIDE_RANGES = rng.random_choice([2, 16, 128])
+        if rng.coinflip(0.25):
+            # tiny bucket cap forces whole-bucket eviction
+            self.PREFILTER_MAX_BUCKETS = rng.random_choice([4, 64, 4096])
+        if rng.coinflip(0.25):
+            # tiny feedback cap forces resolver-side truncation (newest
+            # kept; the proxy just learns less — conservative)
+            self.PREFILTER_FEEDBACK_MAX_RANGES = rng.random_choice([4, 64, 512])
 
     def randomize_read_pipeline(self, rng) -> None:
         """Read-pipeline knob randomization, kept OUT of randomize():

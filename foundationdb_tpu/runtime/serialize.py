@@ -27,11 +27,15 @@ import struct
 #        receivers accept gen-6-shaped per-message frames too, but a
 #        gen-6 build must not peer with a gen-7 one — the handshake
 #        rejects the mix)
-PROTOCOL_VERSION = 0x0FDB00B070010008  # gen-8: watches + change feeds —
-#        storage.feedRead streaming envelope (FeedReadRequest/Reply whole-
-#        version pages riding the super-frame path) and the known_committed
-#        frontier piggybacked on TLogPeekReply; a gen-7 peer would decode
-#        peek replies positionally wrong, so the handshake must reject it
+# gen 8: watches + change feeds — storage.feedRead streaming envelope
+#        (FeedReadRequest/Reply whole-version pages riding the super-
+#        frame path) and the known_committed frontier piggybacked on
+#        TLogPeekReply; a gen-7 peer would decode peek replies
+#        positionally wrong, so the handshake must reject it
+PROTOCOL_VERSION = 0x0FDB00B070010009  # gen-9: proxy conflict pre-filter —
+#        ResolveBatchReply grows committed_ranges + version_floor
+#        (resolver→proxy summary feedback); the codec is positional, so a
+#        gen-8 peer would misparse the reply tail — handshake rejects it
 
 
 class BinaryWriter:

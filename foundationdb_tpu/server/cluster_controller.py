@@ -642,6 +642,12 @@ class ClusterController:
                         snaps.append(snap.get(key))
             return LatencyBands.merge(snaps)
 
+        # commit abort rate (ISSUE 17 satellite): conflicts as a share of
+        # resolved commit attempts — the contention signal the prefilter
+        # bench sweeps used to be the only witness of. Prefiltered txns
+        # count in txnConflicts too (same client-visible not_committed).
+        _committed = agg("proxy", "txnCommitOut")
+        _conflicted = agg("proxy", "txnConflicts")
         doc["workload"] = {
             "transactions": {
                 "started": tx("txnStartIn"),
@@ -649,6 +655,19 @@ class ClusterController:
                 "conflicted": tx("txnConflicts"),
                 "too_old": tx("txnTooOld"),
                 "commit_batches": tx("commitBatchesOut"),
+            },
+            "abort_rate": (
+                round(_conflicted / (_committed + _conflicted), 4)
+                if (_committed + _conflicted) > 0
+                else 0.0
+            ),
+            # conflict pre-filter (ISSUE 17): doomed txns rejected at the
+            # proxy before the batch; checks/feedback are the probe and
+            # learning rates
+            "prefiltered": tx("prefiltered"),
+            "prefilter": {
+                "checks": tx("prefilterChecks"),
+                "feedback_ranges": tx("prefilterFeedbackRanges"),
             },
             "operations": {
                 "reads": sq("finishedQueries"),
@@ -703,8 +722,8 @@ class ClusterController:
                 "resolve": band_agg("resolver", "resolveLatencyBands"),
             },
         }
-        txn_out = agg("proxy", "txnCommitOut")
-        conflicts = agg("proxy", "txnConflicts")
+        txn_out = _committed
+        conflicts = _conflicted
         ops = agg("storage", "finishedQueries")
         doc["qos"] = {
             "transactions_committed_total": txn_out,

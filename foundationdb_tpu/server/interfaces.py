@@ -152,6 +152,14 @@ class ResolveBatchReply:
     # the proxy ANDs the flags across resolvers and applies resolver 0's
     # mutation bytes (commitBatch phase 3, MasterProxyServer:432-450)
     state_mutations: list = field(default_factory=list)
+    # prefilter feedback (ISSUE 17): write ranges this resolver committed
+    # in (last_receive_version, version] as [(version, [(begin, end), ...])],
+    # newest-first, capped at PREFILTER_FEEDBACK_MAX_RANGES ranges; empty
+    # when PROXY_CONFLICT_PREFILTER is off
+    committed_ranges: list = field(default_factory=list)
+    # this resolver's forget horizon — the proxy's summary must drop
+    # entries at/below it (jumps on failover / journal capacity pressure)
+    version_floor: Version = 0
 
 
 # -- tlog (TLogInterface.h) ---------------------------------------------------

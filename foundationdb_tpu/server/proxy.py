@@ -33,6 +33,7 @@ from __future__ import annotations
 import struct
 
 from ..conflict.api import Verdict
+from ..conflict.prefilter import ConflictPrefilter
 from ..errors import GrvThrottled, NotCommitted, TransactionTooOld
 from .admission import GrvAdmission
 from ..kv.keyrange_map import KeyRangeMap
@@ -254,6 +255,21 @@ class Proxy:
         # bounded queues with deadline shedding (grv_throttled). Ungated
         # until a getRate reply arrives (static clusters stay ungated).
         self.admission = GrvAdmission(self.knobs, self.stats)
+        # conflict pre-filter (conflict/prefilter.py, ISSUE 17): decaying
+        # summary of recently committed write ranges, fed from resolver
+        # reply feedback, probed in commit() BEFORE the batch. Its own
+        # CounterCollection (occupancy/decay gauges) nests under the
+        # proxy's metrics as a "prefilter" section; the traffic counters
+        # live on self.stats so status rates ride the proxy trace_loop.
+        self.prefilter = (
+            ConflictPrefilter(self.knobs, uid)
+            if self.knobs.PROXY_CONFLICT_PREFILTER
+            else None
+        )
+        self._c_prefiltered = self.stats.counter("prefiltered")
+        self._c_prefilter_checks = self.stats.counter("prefilterChecks")
+        self._c_prefilter_feedback = self.stats.counter("prefilterFeedbackRanges")
+        self.stats.gauge("prefilter", self._prefilter_snapshot)
 
     # -- GRV -------------------------------------------------------------------
 
@@ -442,13 +458,22 @@ class Proxy:
         # proxy-residency span (queue wait + batch pipeline); the batch's
         # stage spans nest under it via the context stored with the entry
         sp = span("Proxy.commit", self.process.address, proxy=self.uid)
-        self._batch.append((req.transaction, done, sp.context))
-        if len(self._batch) == 1:
-            self._work._set(None)
-        if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
-            self._batch_trigger._set(None)
         try:
             with sp:
+                # pre-filter probe BEFORE the batch: a transaction the
+                # summary proves doomed fails here with the same
+                # retryable error the resolver would hand it, without
+                # consuming a version grant or a batch slot
+                if self.prefilter is not None and self._prefilter_reject(
+                    req.transaction, sp
+                ):
+                    self._c_txn_conflict.add()
+                    raise NotCommitted()
+                self._batch.append((req.transaction, done, sp.context))
+                if len(self._batch) == 1:
+                    self._work._set(None)
+                if len(self._batch) >= self.knobs.MAX_BATCH_TXNS:
+                    self._batch_trigger._set(None)
                 return await done
         finally:
             # failures (conflict/too-old) are client-observed commit
@@ -767,6 +792,20 @@ class Proxy:
         rsp.finish()
         _stage("Proxy.resolve", t_p2, now(), skip_first=True)
         _debug("Resolved")
+        # absorb prefilter feedback: each reply's window is
+        # (last_receive_version, version], and those windows tile exactly
+        # per proxy (last_resolver_versions advances at SEND time in
+        # _send_resolve), so no dedup watermark is needed — and duplicate
+        # feeds would only re-store known ranges anyway (conservative)
+        if self.prefilter is not None:
+            fed = 0
+            for reply in resolutions:
+                fed += self.prefilter.feed(
+                    getattr(reply, "committed_ranges", ()),
+                    getattr(reply, "version_floor", 0),
+                )
+            if fed:
+                self._c_prefilter_feedback.add(fed)
         verdicts = [Verdict.COMMITTED] * len(txns)
         for idxs, reply in zip(resolve_meta, resolutions):
             for i, v in zip(idxs, reply.committed):
@@ -1054,6 +1093,48 @@ class Proxy:
         outliving the role."""
         self.failed = True
         self.admission.fail_all()
+
+    def _prefilter_snapshot(self) -> dict:
+        """Nested "prefilter" section of proxy.metrics — occupancy/decay
+        gauges from the summary's own CounterCollection (the resolver's
+        ``kernel`` gauge nesting is the precedent)."""
+        if self.prefilter is None:
+            return {"enabled": False}
+        snap = self.prefilter.stats.snapshot()
+        snap["enabled"] = True
+        return snap
+
+    def _prefilter_reject(self, t, sp) -> bool:
+        """Probe the summary with ``t``'s read conflict ranges. On a hit,
+        prove the rejection conservative against the sim oracle (every
+        rejection re-run through authoritative history — a false
+        rejection fails the simulation), then emit the Proxy.prefilter
+        stage span + CommitDebug event and tell commit() to fail the
+        transaction locally."""
+        self._c_prefilter_checks.add()
+        t0 = now()
+        if not self.prefilter.check(t.read_snapshot, t.read_conflict_ranges):
+            return False
+        oracle = getattr(
+            getattr(self.process, "sim", None), "prefilter_oracle", None
+        )
+        if oracle is not None:
+            oracle.check_rejection(
+                t.read_snapshot, t.read_conflict_ranges, proxy=self.uid
+            )
+        self._c_prefiltered.add()
+        emit_span(
+            "Proxy.prefilter", self.process.address, sp.context,
+            t0, now(), proxy=self.uid, prefiltered=True,
+        )
+        if getattr(t, "debug_id", ""):
+            from ..runtime.trace import SevInfo, trace
+
+            trace(
+                SevInfo, "CommitDebug", "",
+                Id=t.debug_id, Event="Prefiltered", Proxy=self.uid,
+            )
+        return True
 
     async def _metrics(self, _req) -> dict:  # flowlint: disable=reg-endpoint-span — metrics pull
         return self.stats.snapshot()

@@ -333,6 +333,13 @@ class Resolver:
             if int(v) == int(Verdict.COMMITTED):
                 committed_ranges.extend(t.write_conflict_ranges)
         self.cs.record_committed(req.version, committed_ranges, oldest)
+        # feed the sim-only prefilter oracle at the journal site, BEFORE
+        # the reply carrying feedback is built: its history is then a
+        # superset of any proxy summary (runtime/validation.py)
+        proc = getattr(self, "process", None)
+        oracle = getattr(getattr(proc, "sim", None), "prefilter_oracle", None)
+        if oracle is not None and committed_ranges:
+            oracle.note_committed(req.version, committed_ranges, oldest)
         self._l_resolve.add(now() - t_resolve)
         self._b_resolve.add(now() - t_total)
 
@@ -350,8 +357,32 @@ class Resolver:
             for v, entries in sorted(self._state_txns.items())
             if req.last_receive_version < v <= req.version
         ]
+        # prefilter feedback (ISSUE 17): echo the write ranges committed
+        # in (last_receive_version, version] straight from the journal —
+        # the same entries the failover layer replays, so the proxy's
+        # summary can never claim more than authoritative history. Walk
+        # newest-first so the cap drops the OLDEST ranges (truncation
+        # only delays learning — conservative). The journal floor is the
+        # resolver's forget horizon: it jumps on failover/capacity
+        # pressure, telling the proxy to shrink its summary with us.
+        feedback = []
+        floor = 0
+        if self.knobs.PROXY_CONFLICT_PREFILTER:
+            budget = self.knobs.PREFILTER_FEEDBACK_MAX_RANGES
+            for v, ranges in reversed(self.cs.journal.entries):
+                if v <= req.last_receive_version or budget <= 0:
+                    break
+                if v > req.version:
+                    continue
+                take = ranges[:budget]
+                feedback.append((v, list(take)))
+                budget -= len(take)
+            floor = max(oldest, self.cs.journal.floor)
         reply = ResolveBatchReply(
-            committed=[int(v) for v in verdicts], state_mutations=state
+            committed=[int(v) for v in verdicts],
+            state_mutations=state,
+            committed_ranges=feedback,
+            version_floor=floor,
         )
         self._c_batches.add()
         self._c_txns.add(len(verdicts))

@@ -124,6 +124,26 @@ def snapshot(result, platform):
             PARTIAL,
         )
     )
+    # provenance warning (ISSUE 17 satellite): a vs_baseline whose
+    # denominator came from the shrunk smoke shape does NOT compare
+    # across rounds — 200x2500 is the comparison shape of record
+    shape = entry.get("shape")
+    if entry.get("vs_baseline") and shape and shape != "200x2500":
+        log(
+            "WARNING: vs_baseline=%s quoted from drift-prone shape %s "
+            "(native smoke baseline swings ±18%%); only 200x2500 compares "
+            "across rounds%s"
+            % (
+                entry.get("vs_baseline"),
+                shape,
+                (
+                    " — native_txn_s_200x2500=%s is the reference denominator"
+                    % entry["native_txn_s_200x2500"]
+                    if entry.get("native_txn_s_200x2500")
+                    else ""
+                ),
+            )
+        )
     # kernel counter provenance (bench.py embeds its KernelMetrics
     # snapshot): a capture that paid overflow replays or reshard churn
     # says so next to its number

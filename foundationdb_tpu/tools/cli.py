@@ -134,7 +134,20 @@ class FdbCli:
             lines.append(
                 f"Workload: {hz('started'):.0f} started/s, "
                 f"{hz('committed'):.0f} committed/s, "
-                f"{hz('conflicted'):.0f} conflicted/s"
+                f"{hz('conflicted'):.0f} conflicted/s, "
+                f"abort rate {wl.get('abort_rate') or 0:.2f}"
+            )
+        pf = wl.get("prefiltered") or {}
+        if (wl.get("prefilter") or {}).get("checks", {}).get("counter") or pf.get(
+            "counter"
+        ):
+            pfs = wl.get("prefilter") or {}
+            checks = (pfs.get("checks") or {}).get("counter") or 0
+            lines.append(
+                f"Prefilter: {pf.get('counter') or 0} pre-rejected "
+                f"({pf.get('hz') or 0:.0f}/s) of {checks} checks, "
+                f"{(pfs.get('feedback_ranges') or {}).get('counter', 0)} "
+                f"feedback ranges learned"
             )
         ops = wl.get("operations") or {}
         rb = ops.get("reads_batched") or {}

@@ -47,7 +47,14 @@ WATCH_STAGES = [
     "Client.watch",
     "Storage.watchFire",
 ]
-STAGE_ORDER = COMMIT_STAGES + READ_STAGES + WATCH_STAGES
+# conflict pre-filter (ISSUE 17): Proxy.prefilter is the span for a
+# local pre-rejection (probe→not_committed, no batch), Prefiltered its
+# CommitDebug event — appended so the historical prefix stays byte-stable
+PREFILTER_STAGES = [
+    "Proxy.prefilter",
+    "Prefiltered",
+]
+STAGE_ORDER = COMMIT_STAGES + READ_STAGES + WATCH_STAGES + PREFILTER_STAGES
 
 # event Types that carry chain stages; chain() reads only the commit
 # stream by default (output stability), full_chain() reads both

@@ -45,6 +45,7 @@ from ..workloads import (
     AttritionWorkload,
     BackupWorkload,
     ChangeConfigWorkload,
+    ConflictRangeWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
     DiskFailureWorkload,
@@ -254,8 +255,38 @@ def run_one(
             WatchStormWorkload(db, rng.fork(), watchers=48, keys=6),
         )
     knobs.randomize_watches(shape_rng)
+    # prefilter draws (ISSUE 17) are the NEW end of the sequence. The
+    # conservativeness oracle rides everywhere for free (every sim has a
+    # PrefilterOracle; every pre-rejection is differentially re-proven),
+    # but two dedicated rotations sharpen it: ConflictRangeWorkload
+    # asserts EXACT conflict counts (a false rejection = hard failure,
+    # a missed conflict too), and a hot-keyspace readwrite mix drives
+    # the abort rate up so the filter actually fires under chaos. Knob
+    # draws go both ways (on AND off legs in the matrix) with tiny-cap
+    # shapes forcing the decay/eviction paths.
+    if shape_rng.coinflip(0.35):
+        workloads.insert(
+            len(workloads) - 1,
+            ConflictRangeWorkload(db, rng.fork(), rounds=10, keyspace=16),
+        )
+    if shape_rng.coinflip(0.3):
+        from ..workloads.readwrite import ReadWriteWorkload
+
+        workloads.insert(
+            len(workloads) - 1,
+            ReadWriteWorkload(
+                db, rng.fork(), actors=6, txns_per_actor=10,
+                reads_per_txn=4, writes_per_txn=2, keyspace=12,
+                prefix=b"hot/",
+            ),
+        )
+    knobs.randomize_prefilter(shape_rng)
 
     sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
+    # zero-false-rejection acceptance (ISSUE 17): the oracle raises at
+    # the offending rejection already; this catches a swallowed raise
+    pf_oracle = sim.prefilter_oracle
+    assert not pf_oracle.violations, pf_oracle.violations
     fired = len(sim.buggify.fired)
     sites = buggify_site_names(sim.buggify.fired)
     if verbose:
@@ -277,6 +308,8 @@ def run_one(
         "buggify_sites": sites,
         "kernel_faults_armed": bool(knobs.CONFLICT_FAULT_INJECTION),
         "overload_armed": bool(overload),
+        "prefilter_armed": bool(knobs.PROXY_CONFLICT_PREFILTER),
+        "prefilter_rejections_checked": pf_oracle.rejections_checked,
         "workloads": [type(w).__name__ for w in workloads],
         "config": cfg.as_dict(),
     }

@@ -134,9 +134,12 @@ class Widget:
 """
 
 
-def _lint_tree(tmp_path, worker_src, role_src, span_roles=("widget",)):
+def _lint_tree(
+    tmp_path, worker_src, role_src, span_roles=("widget",),
+    required_counters=None,
+):
     pkg = tmp_path / "foundationdb_tpu" / "server"
-    pkg.mkdir(parents=True)
+    pkg.mkdir(parents=True, exist_ok=True)
     (pkg / "worker.py").write_text(worker_src)
     (pkg / "widget.py").write_text(role_src)
     config = {
@@ -149,6 +152,8 @@ def _lint_tree(tmp_path, worker_src, role_src, span_roles=("widget",)):
         "role_exempt": [],
         "span_roles": list(span_roles),
     }
+    if required_counters is not None:
+        config["role_required_counters"] = required_counters
     return lint(root=tmp_path, config=config)
 
 
@@ -189,6 +194,34 @@ def test_rule_fixture_unresolvable_factory_flagged(tmp_path):
         f.rule == "reg-role-metrics" and f.detail == "unresolved-mystery"
         for f in res.failing
     ), [f.format() for f in res.failing]
+
+
+def test_rule_fixture_required_counter_dropped_flags(tmp_path):
+    """role_required_counters (ISSUE 17 satellite): dropping a pinned
+    counter flags with the exact `<Class>-counter-<name>` detail; the
+    intact role passes the same config (near-miss)."""
+    role = _ROLE_OK.replace(
+        '        self.stats = CounterCollection("widget")\n',
+        '        self.stats = CounterCollection("widget")\n'
+        '        self._c_a = self.stats.counter("prefiltered")\n'
+        '        self._c_b = self.stats.counter("prefilterChecks")\n',
+    )
+    required = {"widget": ["prefiltered", "prefilterChecks"]}
+    res = _lint_tree(tmp_path, _WORKER, role, required_counters=required)
+    assert not res.failing, [f.format() for f in res.failing]
+    # drop one pinned counter → that name flags, the other stays quiet
+    dropped = role.replace(
+        '        self._c_b = self.stats.counter("prefilterChecks")\n', ""
+    )
+    res = _lint_tree(tmp_path, _WORKER, dropped, required_counters=required)
+    assert any(
+        f.rule == "reg-role-metrics"
+        and f.detail == "Widget-counter-prefilterChecks"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+    assert not any(
+        f.detail == "Widget-counter-prefiltered" for f in res.failing
+    )
 
 
 def test_rule_fixture_spanless_endpoint_flagged_and_disable_exempts(tmp_path):

@@ -1,0 +1,268 @@
+"""Proxy conflict pre-filter (ISSUE 17): the decaying committed-write
+summary, its strictly-conservative contract, the resolver feedback loop,
+and the in-sim oracle differential.
+
+The load-bearing property is conservative-only: the filter may MISS
+conflicts (decay, eviction, truncation — all fine, the resolver still
+convicts), but must NEVER reject a transaction the resolver would have
+committed. Unit tests prove each forgetting path only produces false
+negatives; sim tests drive hot-keyspace contention so the filter
+actually fires, and every pre-rejection is differentially re-proven
+against authoritative history (a false rejection raises inside the sim).
+"""
+
+import pytest
+
+from foundationdb_tpu.client import management
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.conflict.prefilter import ConflictPrefilter, _strinc
+from foundationdb_tpu.net.sim import Endpoint, Sim
+from foundationdb_tpu.runtime.futures import spawn
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.workloads import ConflictRangeWorkload, run_workloads
+from foundationdb_tpu.workloads.readwrite import ReadWriteWorkload
+
+
+# -- unit: summary mechanics ---------------------------------------------------
+
+
+def test_strinc():
+    assert _strinc(b"a") == b"b"
+    assert _strinc(b"ab") == b"ac"
+    assert _strinc(b"a\xff") == b"b"  # carry pops the 0xff tail
+    assert _strinc(b"\xff\xff") is None  # open-ended: no successor
+
+
+def _pf(**kw):
+    return ConflictPrefilter(Knobs(**kw))
+
+
+def test_check_requires_exact_overlap_at_newer_version():
+    pf = _pf()
+    pf.feed([(100, [(b"k/a", b"k/b")])])
+    # overlap + older snapshot → reject
+    assert pf.check(50, [(b"k/a", b"k/a\x00")])
+    # snapshot at/after the committed version → commit-safe, no reject
+    assert not pf.check(100, [(b"k/a", b"k/a\x00")])
+    assert not pf.check(150, [(b"k/a", b"k/a\x00")])
+    # disjoint read (half-open: end is exclusive) → no reject
+    assert not pf.check(50, [(b"k/b", b"k/c")])
+    assert not pf.check(50, [(b"k/0", b"k/a")])
+    # empty read set (blind write) can never be rejected
+    assert not pf.check(50, [])
+
+
+def test_wide_ranges_take_the_side_list():
+    pf = _pf(PREFILTER_PREFIX_LEN=4)
+    pf.feed([(100, [(b"aaaa0", b"zzzz9")])])  # spans many prefixes
+    assert len(pf.wide) == 1 and not pf.buckets
+    assert pf.check(50, [(b"mmmm", b"mmmm\x00")])  # middle of the span
+    assert not pf.check(150, [(b"mmmm", b"mmmm\x00")])
+
+
+def test_floor_advance_forgets_conservatively():
+    pf = _pf()
+    pf.feed([(100, [(b"k/a", b"k/b")]), (300, [(b"k/c", b"k/d")])])
+    assert pf.check(50, [(b"k/a", b"k/b")])
+    pf.note_floor(200)  # resolver forgot everything <= 200
+    # the v=100 entry is gone: the reject turns into a (safe) miss
+    assert not pf.check(50, [(b"k/a", b"k/b")])
+    assert pf.check(50, [(b"k/c", b"k/d")])  # v=300 survives
+    assert pf._ranges_decayed == 1
+    # feeds at/below the floor are ignored (already forgotten history)
+    pf.feed([(150, [(b"k/e", b"k/f")])])
+    assert not pf.check(50, [(b"k/e", b"k/f")])
+
+
+def test_eviction_only_forgets():
+    pf = _pf(PREFILTER_BUCKET_ENTRIES=2, PREFILTER_MAX_BUCKETS=2,
+             PREFILTER_WIDE_RANGES=1)
+    # bucket-entry eviction: 3rd entry in one bucket pops the oldest
+    pf.feed([(10, [(b"k/a", b"k/b")]), (20, [(b"k/b", b"k/c")]),
+             (30, [(b"k/c", b"k/d")])])
+    assert not pf.check(5, [(b"k/a", b"k/b")])  # evicted → miss, not wrong
+    assert pf.check(5, [(b"k/c", b"k/d")])
+    # whole-bucket eviction under the bucket cap
+    pf.feed([(40, [(b"m/a", b"m/b")]), (50, [(b"n/a", b"n/b")])])
+    assert len(pf.buckets) <= 2
+    # wide-list overflow keeps the newest
+    pf.feed([(60, [(b"a0", b"z9")]), (70, [(b"b0", b"y9")])])
+    assert len(pf.wide) == 1 and pf.wide[0][2] == 70
+    assert pf._ranges_decayed > 0
+
+
+def test_reset_forgets_everything():
+    pf = _pf()
+    pf.feed([(100, [(b"k/a", b"k/b")])], version_floor=0)
+    pf.reset(floor=500)
+    assert not pf.check(50, [(b"k/a", b"k/b")])
+    assert pf.floor == 500 and not pf.buckets and not pf.wide
+
+
+# -- sim: feedback loop + differential oracle ----------------------------------
+
+
+def _hot_cluster(seed, knobs=None, keyspace=10, actors=8, txns=25):
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(n_proxies=2, n_resolvers=2, n_tlogs=1, n_storage=2),
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    wl = ReadWriteWorkload(
+        db, sim.loop.random.fork(), actors=actors, txns_per_actor=txns,
+        reads_per_txn=4, writes_per_txn=2, keyspace=keyspace, prefix=b"hot/",
+    )
+    return sim, cluster, db, wl
+
+
+def _status(sim, cluster, db, workloads):
+    async def body():
+        await run_workloads(workloads)
+        return await management.get_status(cluster.coordinators, db.client)
+
+    return sim.run_until_done(spawn(body()), 1800.0)
+
+
+def test_prefilter_fires_under_contention_and_oracle_holds():
+    """Hot keyspace → the summary learns committed ranges from resolver
+    feedback and pre-rejects doomed txns; every rejection re-proven by
+    the differential oracle; the status/abort-rate surface populates."""
+    sim, cluster, db, wl = _hot_cluster(seed=1701)
+    doc = _status(sim, cluster, db, [wl])
+    wld = doc["workload"]
+    pre = wld["prefiltered"]["counter"]
+    assert pre > 0, wld
+    assert wld["prefilter"]["checks"]["counter"] >= pre
+    assert wld["prefilter"]["feedback_ranges"]["counter"] > 0
+    assert 0.0 < wld["abort_rate"] <= 1.0
+    # the oracle actually audited those rejections — zero violations
+    assert sim.prefilter_oracle.rejections_checked >= pre
+    assert not sim.prefilter_oracle.violations
+
+
+def test_prefilter_knob_off_is_inert():
+    sim, cluster, db, wl = _hot_cluster(
+        seed=1701, knobs=Knobs(PROXY_CONFLICT_PREFILTER=False)
+    )
+    doc = _status(sim, cluster, db, [wl])
+    wld = doc["workload"]
+    assert wld["prefiltered"]["counter"] == 0
+    assert wld["prefilter"]["checks"]["counter"] == 0
+    assert sim.prefilter_oracle.rejections_checked == 0
+    # abort-rate surface works without the filter too
+    assert wld["abort_rate"] > 0.0
+
+
+def test_conflict_oracle_workload_exact_with_prefilter():
+    """ConflictRangeWorkload asserts EXACT conflict counts — a false
+    rejection (or a filter-induced missed conflict) fails it."""
+    sim, cluster, db, _ = _hot_cluster(seed=77)
+    wl = ConflictRangeWorkload(
+        db, sim.loop.random.fork(), rounds=12, keyspace=16
+    )
+    _status(sim, cluster, db, [wl])
+    assert not sim.prefilter_oracle.violations
+
+
+def test_journal_pressure_shrinks_summary_zero_false_rejections():
+    """Pinned-seed shrink test (ISSUE 17 satellite): a tiny resolver
+    journal forces the version floor to jump under capacity pressure
+    (the same mechanism a rollback/failover replay uses), the feedback
+    propagates the jump, and the proxy summaries shrink with it — with
+    zero false rejections throughout, proven by the differential."""
+    knobs = Knobs(CONFLICT_JOURNAL_CAPACITY=4)
+    sim, cluster, db, wl = _hot_cluster(seed=424, knobs=knobs)
+    _status(sim, cluster, db, [wl])
+    # find the live proxies' prefilters and check the floor advanced
+    # (the journal's capacity evictions must have pushed it up)
+    floors = []
+    for p in sim.processes.values():
+        wk = getattr(p, "worker", None)
+        if wk is None or not p.alive:
+            continue
+        for h in wk.roles.values():
+            if h.kind == "proxy" and getattr(h.obj, "prefilter", None):
+                floors.append(h.obj.prefilter.floor)
+    assert floors and max(floors) > 0, floors
+    assert not sim.prefilter_oracle.violations
+
+
+def test_prefilter_survives_recovery_chaos():
+    """Attrition-style chaos (a proxy/resolver death forces recovery;
+    replacement proxies start with EMPTY summaries, replacement
+    resolvers replay the journal): the differential must stay clean."""
+    from foundationdb_tpu.workloads import AttritionWorkload
+
+    sim, cluster, db, wl = _hot_cluster(seed=99, actors=6, txns=20)
+    chaos = AttritionWorkload(
+        db, sim.loop.random.fork(), sim=sim, kills=2, interval=3.0,
+        protect=set(cluster.coordinators),
+    )
+    _status(sim, cluster, db, [wl, chaos])
+    assert not sim.prefilter_oracle.violations
+
+
+def test_prefilter_span_attributed_in_critical_path():
+    """A pre-rejected transaction's self-time lands on the
+    Proxy.prefilter stage in the span waterfall (satellite 2)."""
+    from foundationdb_tpu.runtime.trace import TraceLog, set_trace_log
+    from foundationdb_tpu.tools import trace_analyze as ta
+
+    log = TraceLog()
+    set_trace_log(log)
+    try:
+        sim, cluster, db, wl = _hot_cluster(seed=1701)
+        sim.knobs.TRACE_SAMPLE_RATE = 1.0
+        doc = _status(sim, cluster, db, [wl])
+        assert doc["workload"]["prefiltered"]["counter"] > 0
+        spans = [e for e in log.events if e.get("Type") == "Span"]
+        pf_spans = [s for s in spans if s.get("Name") == "Proxy.prefilter"]
+        assert pf_spans, "no Proxy.prefilter spans at sample rate 1.0"
+        # nested under the commit: parent chain gives the stage a home
+        cp = ta.critical_path(log.events, root_prefix="Client.commit")
+        stages = {
+            s["stage"]
+            for agg in cp.values()
+            for s in agg.get("stages", [])
+        }
+        assert "Proxy.prefilter" in stages, stages
+    finally:
+        set_trace_log(TraceLog())
+
+
+def test_cli_status_renders_prefilter_and_abort_rate():
+    """`cli status` shows the abort rate on the Workload line and a
+    Prefilter line once the filter has fired (satellite 1 + tentpole)."""
+    from foundationdb_tpu.tools.cli import FdbCli
+
+    sim, cluster, db, wl = _hot_cluster(seed=1701)
+    cli = FdbCli(db, cluster.coordinators)
+
+    async def body():
+        await run_workloads([wl])
+        return await cli.execute("status")
+
+    out = sim.run_until_done(spawn(body()), 1800.0)
+    assert "abort rate" in out, out
+    assert "Prefilter:" in out and "pre-rejected" in out, out
+
+
+# -- satellite 4: bindingtester byte-identical with the knob both ways ---------
+
+
+def test_bindingtester_byte_identical_knob_both_ways():
+    from tests.test_bindingtester import run_model, run_real
+
+    seed, n_ops = 4217, 120
+    stream, (data_on, log_on) = run_real(
+        seed, n_ops, knobs=Knobs(PROXY_CONFLICT_PREFILTER=True)
+    )
+    _, (data_off, log_off) = run_real(
+        seed, n_ops, knobs=Knobs(PROXY_CONFLICT_PREFILTER=False)
+    )
+    data_model, log_model = run_model(stream)
+    assert data_on == data_off == data_model
+    assert log_on == log_off == log_model

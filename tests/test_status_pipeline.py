@@ -86,6 +86,11 @@ def test_status_pipeline_end_to_end():
     assert wl["operations"]["writes"]["counter"] >= 25 + 3 * 150
     assert wl["operations"]["bytes_written"]["counter"] > 0
     assert wl["operations"]["reads"]["counter"] >= 0
+    # abort rate + prefilter surface (ISSUE 17): present and sane even
+    # on an uncontended run
+    assert 0.0 <= wl["abort_rate"] <= 1.0
+    assert wl["prefiltered"]["counter"] >= 0
+    assert wl["prefilter"]["checks"]["counter"] >= 0
 
     # -- qos: totals + ratekeeper rate + durability-lag roll-up
     qos = doc["qos"]

@@ -431,6 +431,9 @@ def test_commit_chain_back_compat_and_read_stages():
         "ClientCommitDone",
     ]
     assert "ClientReadStart" in STAGE_ORDER and "StorageRead" in STAGE_ORDER
+    # prefilter stages (ISSUE 17) append after the watch stages so the
+    # historical prefix stays byte-stable
+    assert STAGE_ORDER[-2:] == ["Proxy.prefilter", "Prefiltered"]
 
     log = _fresh_log()
     sim = Sim(seed=47)
