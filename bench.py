@@ -701,6 +701,136 @@ def bench_prefilter():
     }))
 
 
+def bench_commit_path():
+    """BENCH_COMPONENT=commit_path: the ISSUE-18 commit-path A/B. Three
+    mechanisms behind one legacy flag (--commit-path-legacy pins the
+    interpretive codec + per-waiter settling + serialized tlog fsync):
+      - codec micro (perf --codec-micro): the compiled codec's isolated
+        encode/decode speedup + the byte-identity verdict;
+      - cluster rows: 50/50 TCP (multi-process, the round-5/7/9 regime)
+        and the write row, ON vs legacy, same-day interleaved; ON leg
+        embeds status evidence (workload.tlog fsync rounds/group joins);
+      - the colocated tcp-inproc 50/50 + write rows, where the delta is
+        measurable on this one-core box (multi-proc swings +-9%);
+        run_loop profiler snapshots ride in every leg.
+    native_txn_s rides along from the native conflict-set baseline (the
+    ROADMAP's denominator discipline). Writes BENCH_r12.json."""
+    import subprocess
+    import time as _time
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    actors = int(os.environ.get("BENCH_CP_ACTORS", "40"))
+    txns = int(os.environ.get("BENCH_CP_TXNS", "120"))
+    procs = int(os.environ.get("BENCH_CP_PROCS", "2"))
+
+    # ---- native conflict-set baseline (the denominator on record) ----
+    from foundationdb_tpu.conflict.native import NativeConflictSet
+
+    nb, nt = 40, 640  # CPU smoke shape (ROADMAP: quote shape with ratio)
+    nat = NativeConflictSet()
+    global BATCHES, TXNS
+    old_shape = (BATCHES, TXNS)
+    BATCHES, TXNS = nb, nt
+    nat_batches = make_batches(nb, nt)
+    BATCHES, TXNS = old_shape
+    nat_enc = [nat.encode_batch(txs) for txs in nat_batches]
+    t0 = _time.perf_counter()
+    for i, enc in enumerate(nat_enc):
+        nat.resolve_encoded(enc, i + WINDOW, i)
+    nat_tps = nb * nt / (_time.perf_counter() - t0)
+    log(f"native baseline ({nb}x{nt}): {nat_tps/1e6:.3f} Mtxn/s")
+
+    def run_perf(extra, workload="50_50", timeout=1800, mode="tcp"):
+        cmd = [
+            sys.executable, "-m", "foundationdb_tpu.tools.perf",
+            "--mode", mode, "--workload", workload,
+            "--actors", str(actors), "--txns", str(txns),
+            "--client-procs", str(procs), "--parallel-reads",
+        ] + extra
+        log("running: " + " ".join(cmd[3:]))
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+        for ln in (r.stderr or "").strip().splitlines()[-4:]:
+            log("perf| " + ln)
+        lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+        return json.loads(lines[-1]) if lines else None
+
+    # ---- codec micro (isolated wire-layer contribution) ----
+    micro = run_perf(["--codec-micro"], mode="sim")  # mode ignored by flag
+    if micro:
+        log(
+            f"codec micro: encode x{micro.get('encode_speedup')} decode "
+            f"x{micro.get('decode_speedup')} compiled, byte_identical="
+            f"{micro.get('byte_identical')}"
+        )
+
+    # ---- cluster rows: interleaved ON/legacy pairs, same day ----
+    on = run_perf(["--status-json"])
+    off = run_perf(["--commit-path-legacy"])
+    inproc_on = run_perf([], mode="tcp-inproc")
+    inproc_off = run_perf(["--commit-path-legacy"], mode="tcp-inproc")
+    # the write row is where the commit path IS the workload (0r+10w:
+    # every op is a mutation through codec + slab settle + tlog fsync)
+    write_on = run_perf([], mode="tcp-inproc", workload="write")
+    write_off = run_perf(
+        ["--commit-path-legacy"], mode="tcp-inproc", workload="write"
+    )
+
+    def ratio(a, b, metric="ops_per_s"):
+        return round(
+            ((a or {}).get(metric) or 0.0)
+            / max((b or {}).get(metric) or 0.0, 1e-9),
+            2,
+        )
+
+    ops_on = (on or {}).get("ops_per_s", 0.0)
+    ops_off = (off or {}).get("ops_per_s", 0.0)
+    round5_5050 = 5186.0  # BENCH_NOTES round-5 50/50 TCP row
+    tlog_ev = (((on or {}).get("status") or {}).get("workload") or {}).get(
+        "tlog"
+    )
+    artifact = {
+        "metric": "commit_path_50_50_tcp",
+        "value": ops_on,
+        "unit": "ops/s",
+        "vs_baseline": round(ops_on / 107_000.0, 4),  # reference row
+        "vs_legacy": round(ops_on / max(ops_off, 1e-9), 2),
+        "vs_round5_row": round(ops_on / round5_5050, 2),
+        "native_txn_s": round(nat_tps, 1),
+        "native_shape": f"{nb}x{nt}",
+        "shape": f"50_50 x {actors} actors x {txns} txns x {procs} procs",
+        "round5_50_50_ops_per_s": round5_5050,
+        "inproc_50_50_vs_legacy": ratio(inproc_on, inproc_off),
+        "write_vs_legacy": ratio(write_on, write_off, "writes_per_s"),
+        "codec_micro": micro,
+        "tlog_status_on": tlog_ev,
+        "on": on,
+        "legacy": off,
+        "inproc_50_50_on": inproc_on,
+        "inproc_50_50_legacy": inproc_off,
+        "write_on": write_on,
+        "write_legacy": write_off,
+    }
+    with open(os.path.join(repo, "BENCH_r12.json"), "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    log(
+        f"commit path 50/50 tcp: ON {ops_on:.0f} ops/s vs legacy "
+        f"{ops_off:.0f} ops/s ({artifact['vs_legacy']:.2f}x multi-proc); "
+        f"in-proc {artifact['inproc_50_50_vs_legacy']:.2f}x; write row "
+        f"{artifact['write_vs_legacy']:.2f}x; tlog evidence {tlog_ev}"
+    )
+    print(json.dumps({
+        k: artifact[k]
+        for k in (
+            "metric", "value", "unit", "vs_baseline", "vs_legacy",
+            "inproc_50_50_vs_legacy", "write_vs_legacy", "vs_round5_row",
+            "native_txn_s", "native_shape", "shape",
+        )
+    }))
+
+
 def bench_admission():
     """BENCH_COMPONENT=admission: the overload A/B (ISSUE 13). Two legs of
     tools/perf --overload-factor (same seed, same offered load): admission
@@ -1212,6 +1342,9 @@ def main():
         return
     if os.environ.get("BENCH_COMPONENT") == "prefilter":
         bench_prefilter()
+        return
+    if os.environ.get("BENCH_COMPONENT") == "commit_path":
+        bench_commit_path()
         return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 

@@ -53,6 +53,7 @@ class DiskQueue:
         self._durable_pop = 0  # highest popped frontier made durable
         self.commits = 0  # physical write+fsync rounds
         self.group_joins = 0  # commit() calls satisfied by another round
+        self.fsync_seconds = 0.0  # cumulative time inside write+fsync rounds
 
     # -- recovery --------------------------------------------------------------
 
@@ -133,6 +134,9 @@ class DiskQueue:
                 # lazy open for a freshly created queue (first commit wins;
                 # the tlog's version gate serializes callers)
                 await self.recover()
+            from ..runtime.loop import now
+
+            t0 = now()
             end_now = self._end
             pop_now = self._popped
             if self._buffer:
@@ -148,6 +152,7 @@ class DiskQueue:
             self._durable_end = max(self._durable_end, end_now)
             self._durable_pop = max(self._durable_pop, pop_now)
             self.commits += 1
+            self.fsync_seconds += now() - t0
         finally:
             done, self._commit_active = self._commit_active, None
             done._set(None)

@@ -13,6 +13,10 @@ Semantics parity is deliberate: every message still round-trips through
 the wire codec (``wire.encode_value``/``decode_value``), so loopback
 peers exchange *copies* — unserializable payloads, schema drift, and
 mutation-aliasing bugs surface exactly as they would over a socket.
+(That round-trip is also why loopback benefits from the schema-compiled
+codec: with WIRE_COMPILED_CODEC on, the per-message encode/decode here
+runs the generated whole-struct pack/unpack instead of the per-field
+interpretive walk.)
 Delivery is scheduled (one ZERO-priority drain per tick per direction,
 mirroring the TCP flush tick), so replies never resolve synchronously
 and batches arrive as one batch-dispatch — same shape as a gen-7

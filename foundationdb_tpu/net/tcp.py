@@ -41,7 +41,15 @@ import socket
 from typing import Any, Callable, Optional
 
 from ..errors import FdbError
-from ..runtime.futures import ActorCollection, Cancelled, Future, Task, spawn, start_batch
+from ..runtime.futures import (
+    ActorCollection,
+    Cancelled,
+    Future,
+    Task,
+    settle_batch,
+    spawn,
+    start_batch,
+)
 from ..runtime.knobs import Knobs
 from ..runtime.loop import RealLoop, TaskPriority, set_loop
 from ..runtime.trace import SevError, SevInfo, SevWarn, trace
@@ -503,6 +511,14 @@ class RealWorld:
         # same loop bypass sockets entirely. TLS worlds never loop back —
         # their peer-authentication story must not be silently bypassed.
         self._loopback_ok = bool(self.knobs.TRANSPORT_LOOPBACK) and tls is None
+        # commit-path codec/settle modes (ISSUE 18). Both are process-wide
+        # (the codec registry and the settle slab are module state), so
+        # colocated worlds in one process follow the last world's knobs —
+        # A/B runs configure every world identically.
+        wire.set_compiled_codec(bool(getattr(self.knobs, "WIRE_COMPILED_CODEC", True)))
+        from ..runtime import futures as _futures
+
+        _futures.set_slab_settle(bool(getattr(self.knobs, "FUTURE_SLAB_SETTLE", True)))
         self._listen()
         self.transport_metrics.stats.id = self.node.address
         loopback.register(self)
@@ -858,13 +874,17 @@ class RealWorld:
 
     def _on_batch(self, conn, msgs: list) -> None:
         """Batch dispatch for one inbound frame (or loopback drain):
-        replies resolve inline; the frame's REQUESTS all start in a single
-        loop step (futures.start_batch) — N handler wakeups collapse into
-        one, which is where the per-request wakeup tax went (run-loop
-        profiler evidence, ISSUE 14)."""
+        the frame's REQUESTS all start in a single loop step
+        (futures.start_batch) — N handler wakeups collapse into one,
+        which is where the per-request wakeup tax went (run-loop profiler
+        evidence, ISSUE 14) — and the frame's REPLIES batch-settle the
+        same way (futures.settle_batch): one super-frame of N reply
+        payloads resumes its N waiter tasks via per-priority
+        call_soon_batch entries instead of N individual wakeups."""
         from ..runtime import trace as _trace
 
         tasks: list[Task] = []
+        settles: list = []  # (caller future, value, error)
         for msg in msgs:
             kind = msg[0]
             if kind == "req":
@@ -889,33 +909,34 @@ class RealWorld:
                 _k, rid, value = msg
                 ent = self._pending_pop(rid)
                 if ent is not None and not ent[0].is_ready():
-                    ent[0]._set(value)
+                    settles.append((ent[0], value, None))
             elif kind == "err":
-                self._on_reply_err(msg)
+                _k, rid, etype, detail = msg
+                ent = self._pending_pop(rid)
+                if ent is not None and not ent[0].is_ready():
+                    settles.append((ent[0], None, self._reply_exc(etype, detail)))
             else:
                 trace(SevWarn, "WireBadKind", self.node.address, Kind=str(kind))
         start_batch(tasks)
+        settle_batch(settles)
 
     def _on_message(self, conn, msg) -> None:
         self._on_batch(conn, [msg])
 
-    def _on_reply_err(self, msg) -> None:
-        _k, rid, etype, detail = msg
-        ent = self._pending_pop(rid)
-        if ent is None or ent[0].is_ready():
-            return
+    @staticmethod
+    def _reply_exc(etype, detail) -> BaseException:
+        """Reconstruct the caller-side exception for an ``err`` reply."""
         if etype == "fdb":
             from .. import errors as _errors
 
             cls = getattr(_errors, str(detail), FdbError)
             if not (isinstance(cls, type) and issubclass(cls, FdbError)):
                 cls = FdbError
-            ent[0]._set_error(cls(str(detail)))
-        elif etype == "broken_promise":
-            ent[0]._set_error(BrokenPromise(str(detail)))
-        elif etype == "named":
+            return cls(str(detail))
+        if etype == "broken_promise":
+            return BrokenPromise(str(detail))
+        if etype == "named":
             name, text = detail
             cls = _named_errors().get(str(name), RemoteError)
-            ent[0]._set_error(cls(str(text)))
-        else:
-            ent[0]._set_error(RemoteError(str(detail)))
+            return cls(str(text))
+        return RemoteError(str(detail))

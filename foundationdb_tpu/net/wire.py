@@ -43,9 +43,14 @@ _packers: dict[type, tuple[str, callable, callable]] = {}
 
 
 def register_struct(cls: type) -> type:
-    """Register a dataclass for wire transport (by class name)."""
+    """Register a dataclass for wire transport (by class name). Also
+    code-gens the schema-compiled encoder/decoder pair for the class
+    (see the compiled-codec section below) — registration IS the schema
+    compilation step, so a class can never be reachable on the wire
+    without a matching compiled codec."""
     assert dataclasses.is_dataclass(cls), cls
     _struct_by_name[cls.__name__] = cls
+    _compile_struct_codec(cls)
     return cls
 
 
@@ -254,6 +259,14 @@ def _resolve_encoder(cls: type):
             out.append(_h)
             _enc_tuple(out, _g(v))
 
+        # prefer the schema-compiled encoder when the codec is on; the
+        # interpretive closure above remains the set_compiled_codec(False)
+        # path (the dispatch entry is evicted on toggle and re-resolved)
+        if _COMPILED_ON:
+            comp = _COMPILED_ENC.get(cls)
+            if comp is not None:
+                f = comp
+
     # subclasses of the concrete containers/scalars (NamedTuples, int
     # subclasses that are not IntEnum, ...) encode as their base type —
     # the format has no tag for them
@@ -380,6 +393,10 @@ def _dec_enum(r):
 
 def _dec_struct(r):
     name = r.take(r.u16()).decode()
+    if _COMPILED_ON:
+        dec = _COMPILED_DEC.get(name)
+        if dec is not None:
+            return dec(r)
     entry = _struct_by_name.get(name)
     v = _dec(r)
     if entry is None:
@@ -418,10 +435,20 @@ def _dec(r: _Reader):
     return _DEC_DISPATCH[tag](r)
 
 
+_OUT_FREE: list = []
+
+
 def encode_value(v) -> bytes:
-    out: list = []
+    # chunk-list reuse: encoding is synchronous (no awaits anywhere under
+    # _enc), so a small free pool of chunk lists is only ever touched
+    # between top-level encodes; an encode that raises abandons its list
+    out: list = _OUT_FREE.pop() if _OUT_FREE else []
     _enc(out, v)
-    return b"".join(out)
+    b = b"".join(out)
+    out.clear()
+    if len(_OUT_FREE) < 8:
+        _OUT_FREE.append(out)
+    return b
 
 
 def decode_value(buf):
@@ -435,6 +462,212 @@ def decode_value(buf):
     if r.pos != len(buf):
         raise WireError("trailing bytes in message")
     return v
+
+
+# -- schema-compiled codec -----------------------------------------------------
+#
+# Second-generation hot path. The dispatch codec above still walks one
+# Python frame per field of every struct (_enc_tuple -> _enc -> dict hit
+# per field). Registered dataclasses ARE the schema (field order by
+# convention), so register_struct() code-gens one specialized encode and
+# one specialized decode function per class:
+#
+#   * the struct header, tuple tag and field count collapse into a single
+#     precomputed prefix constant (one append instead of four);
+#   * scalar fields (int/bytes/str/None/bool/float) inline their tag
+#     handling behind EXACT-class guards (``x.__class__ is int``);
+#   * anything that fails a guard — a subclass, a container, a nested
+#     struct, an enum — falls through to the generic _enc/_dec walk.
+#
+# The fallback rule is what makes byte-identity with the interpretive
+# codec structural rather than aspirational: every inline fast path is a
+# transcription of the matching _ENC_DISPATCH/_DEC_DISPATCH entry, and
+# everything else bottoms out in literally the same helpers. The wire
+# format is UNCHANGED (gen-9, no protocol bump); tests/test_wire_codec.py
+# proves identity by fuzzed differential plus a golden-bytes fixture.
+#
+# set_compiled_codec(False) (knob WIRE_COMPILED_CODEC) restores the
+# interpretive path for A/B runs and the differential harness.
+
+_COMPILED_ON = True
+_COMPILED_ENC: dict = {}  # cls -> enc(out, v)
+_COMPILED_DEC: dict = {}  # class name -> dec(reader)
+_COMPILED_META: dict = {}  # class name -> (cls, field-name tuple)
+
+_ENC_FIELD_TMPL = """\
+    x = v.{fname}
+    t = x.__class__
+    if t is int:
+        if -128 <= x < 4096:
+            ap(_si[x + 128])
+        else:
+            ap(_ib(x))
+    elif t is bytes:
+        ap(_bb)
+        ap(_u32(len(x)))
+        ap(x)
+    elif t is str:
+        _es(out, x)
+    elif t is _NT:
+        ap(_bn)
+    elif t is bool:
+        ap(_bt if x else _bf)
+    elif t is float:
+        ap(_bfl)
+        ap(_f64(x))
+    else:
+        _e(out, x)
+"""
+
+_DEC_FIELD_TMPL = """\
+    tag = buf[pos]
+    if tag == 3:
+        ln = buf[pos + 1]
+        end = pos + 2 + ln
+        {f} = int.from_bytes(buf[pos + 2 : end], "little", signed=True)
+        pos = end
+    elif tag == 5:
+        (ln,) = _u32f(buf, pos + 1)
+        end = pos + 5 + ln
+        x = buf[pos + 5 : end]
+        if len(x) != ln:
+            raise _we("truncated message")
+        {f} = bytes(x) if mv else x
+        pos = end
+    elif tag == 6:
+        (ln,) = _u32f(buf, pos + 1)
+        end = pos + 5 + ln
+        x = buf[pos + 5 : end]
+        if len(x) != ln:
+            raise _we("truncated message")
+        {f} = str(x, "utf-8")
+        pos = end
+    elif tag == 0:
+        {f} = None
+        pos += 1
+    elif tag == 1:
+        {f} = True
+        pos += 1
+    elif tag == 2:
+        {f} = False
+        pos += 1
+    else:
+        r.pos = pos
+        {f} = _d(r)
+        pos = r.pos
+"""
+
+
+def _compile_struct_codec(cls: type) -> None:
+    """Code-gen the specialized encoder/decoder pair for a registered
+    dataclass and record the schema it was generated from (codec_audit
+    checks the recorded field tuple against the live class)."""
+    name = cls.__name__
+    fields = tuple(fl.name for fl in dataclasses.fields(cls))
+    pre = _struct_header(name) + _B_TUPLE + _U32(len(fields))
+
+    src = ["def enc(out, v):", "    ap = out.append", "    ap(_pre)"]
+    for fname in fields:
+        src.append(_ENC_FIELD_TMPL.format(fname=fname))
+    ens = {
+        "_pre": pre,
+        "_si": _SMALL_INTS,
+        "_ib": _int_bytes,
+        "_bb": _B_BYTES,
+        "_u32": _U32,
+        "_es": _enc_str_v,
+        "_NT": type(None),
+        "_bn": _B_NONE,
+        "_bt": _B_TRUE,
+        "_bf": _B_FALSE,
+        "_bfl": _B_FLOAT,
+        "_f64": _F64,
+        "_e": _enc,
+    }
+    exec("\n".join(src), ens)
+
+    fvars = [f"f{i}" for i in range(len(fields))]
+    src = [
+        "def dec(r):",
+        "    buf = r.buf",
+        "    pos = r.pos",
+        # a payload that is not a tuple of exactly our arity (schema drift
+        # from a same-version peer, or a hand-built message) takes the
+        # generic walk — same constructor call, same errors
+        "    if buf[pos] != 7:",
+        "        return _cls(*_d(r))",
+        "    (n,) = _u32f(buf, pos + 1)",
+        f"    if n != {len(fields)}:",
+        "        return _cls(*_d(r))",
+        "    pos += 5",
+        "    mv = r._mv",
+    ]
+    for f in fvars:
+        src.append(_DEC_FIELD_TMPL.format(f=f))
+    src.append("    r.pos = pos")
+    src.append(f"    return _cls({', '.join(fvars)})")
+    dns = {
+        "_cls": cls,
+        "_d": _dec,
+        "_u32f": _U32_UNPACK_FROM,
+        "_we": WireError,
+    }
+    exec("\n".join(src), dns)
+
+    _COMPILED_ENC[cls] = ens["enc"]
+    _COMPILED_DEC[name] = dns["dec"]
+    _COMPILED_META[name] = (cls, fields)
+    # a re-registered (reloaded) class must not keep serving a previously
+    # resolved encoder
+    _ENC_DISPATCH.pop(cls, None)
+
+
+def set_compiled_codec(on: bool) -> None:
+    """Select the compiled (True) or interpretive (False) struct codec.
+    Evicts resolved dataclass encoders so _resolve_encoder re-binds under
+    the new mode; decode consults the flag per struct header."""
+    global _COMPILED_ON
+    on = bool(on)
+    if on == _COMPILED_ON:
+        return
+    _COMPILED_ON = on
+    for cls in list(_COMPILED_ENC):
+        _ENC_DISPATCH.pop(cls, None)
+
+
+def compiled_codec_enabled() -> bool:
+    return _COMPILED_ON
+
+
+def codec_audit() -> list:
+    """Staleness gate over the compiled codec (the collection-audit
+    analog of flowlint's role_required_counters): every register_struct
+    dataclass must have a compiled encoder/decoder generated from the
+    class's CURRENT field list. Returns a list of problem strings —
+    empty means clean. Catches registry pokes that bypass
+    register_struct and field drift after generation."""
+    problems = []
+    for name, entry in sorted(_struct_by_name.items()):
+        if isinstance(entry, tuple):
+            continue  # register_custom: hand-written pack/unpack pair
+        meta = _COMPILED_META.get(name)
+        if meta is None:
+            problems.append(f"{name}: registered struct has no compiled codec")
+            continue
+        cls, fields = meta
+        if cls is not entry:
+            problems.append(f"{name}: compiled codec bound to a stale class")
+            continue
+        current = tuple(fl.name for fl in dataclasses.fields(entry))
+        if current != fields:
+            problems.append(
+                f"{name}: fields drifted since codec generation "
+                f"({list(fields)} -> {list(current)}) — re-register to re-gen"
+            )
+            continue
+        if entry not in _COMPILED_ENC or name not in _COMPILED_DEC:
+            problems.append(f"{name}: compiled encoder/decoder missing")
+    return problems
 
 
 # -- frames --------------------------------------------------------------------

@@ -197,6 +197,17 @@ class Task:
                 or task._waiting_on is not f
             ):
                 return
+            col = task.loop._wake_collector
+            if col is not None:
+                # settle-slab mode (settle_batch): record the wakeup; the
+                # installer reschedules the whole slab in per-priority
+                # call_soon_batch entries. All resume-eligibility guards
+                # ran above, exactly as on the direct path.
+                if f._error is not None:
+                    col.append((task, None, f._error))
+                else:
+                    col.append((task, f._value, None))
+                return
             if f._error is not None:
                 task.loop.call_soon(
                     lambda: task._step(None, f._error), task.priority, task.name
@@ -234,6 +245,77 @@ def start_batch(tasks: list) -> None:
         [((lambda t=t: t._step(None, None)), t.name) for t in tasks],
         tasks[0].priority,
     )
+
+
+# slab settling on/off (knob FUTURE_SLAB_SETTLE): off restores the
+# one-call_soon-per-wakeup path for A/B runs and chaos coverage
+_SLAB_ON = True
+
+
+def set_slab_settle(on: bool) -> None:
+    global _SLAB_ON
+    _SLAB_ON = bool(on)
+
+
+def slab_settle_enabled() -> bool:
+    return _SLAB_ON
+
+
+def settle_batch(settlements: list) -> None:
+    """Settle many ``(future, value, error)`` triples in ONE loop step —
+    the completion-side mirror of start_batch. A super-frame of N replies
+    (net/tcp.py) or a GRV batch fan-out used to pay one call_soon per
+    woken waiter task; here every ``_set`` runs under a slab collector
+    (loop._wake_collector), the woken tasks are grouped by priority, and
+    each priority group resumes via one call_soon_batch entry — per-item
+    profiler attribution preserved (BATCH_OWNER discipline).
+
+    Semantics match per-item settling exactly: non-Task callbacks still
+    fire synchronously inside ``_set`` (cascaded Task wakeups they cause
+    are collected too), wake-eligibility guards run at fire time as
+    usual, and priority ordering across groups is the heap's as before.
+    With slab settling off (set_slab_settle) this degrades to the plain
+    per-item loop."""
+    if not settlements:
+        return
+    if not _SLAB_ON or len(settlements) == 1:
+        for fut, value, err in settlements:
+            if err is not None:
+                fut._set_error(err)
+            else:
+                fut._set(value)
+        return
+    loop = current_loop()
+    collected: list = []
+    prev = loop._wake_collector
+    loop._wake_collector = collected
+    try:
+        for fut, value, err in settlements:
+            if err is not None:
+                fut._set_error(err)
+            else:
+                fut._set(value)
+    finally:
+        loop._wake_collector = prev
+    if not collected:
+        return
+    if len(collected) == 1:
+        task, value, err = collected[0]
+        loop.call_soon(
+            lambda: task._step(value, err), task.priority, task.name
+        )
+        return
+    by_pri: dict = {}
+    for item in collected:
+        by_pri.setdefault(item[0].priority, []).append(item)
+    for pri, items in by_pri.items():
+        loop.call_soon_batch(
+            [
+                ((lambda t=t, v=v, e=e: t._step(v, e)), t.name)
+                for t, v, e in items
+            ],
+            pri,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -465,13 +547,20 @@ class RequestBatcher:
                             w._set_error(Cancelled())
                     raise
                 except BaseException as e:
-                    for w in waiters:
-                        if not w.is_ready():
-                            w._set_error(e)
+                    settle_batch(
+                        [(w, None, e) for w in waiters if not w.is_ready()]
+                    )
                     continue
-                for w in waiters:
+                if len(waiters) == 1:
+                    # no-hedge single-waiter fast path: resolve the lone
+                    # caller's future directly, no slab machinery
+                    w = waiters[0]
                     if not w.is_ready():
                         w._set(value)
+                else:
+                    settle_batch(
+                        [(w, value, None) for w in waiters if not w.is_ready()]
+                    )
         finally:
             self._running = False
 

@@ -121,6 +121,19 @@ class Knobs:
     # tlog
     TLOG_SPILL_THRESHOLD = 1 << 20
     TLOG_FSYNC_TIME = 0.0002  # modeled DiskQueue sync (SSD-class fsync)
+    # commit path at wire speed (ISSUE 18) — all three are A/B'd together
+    # by BENCH_COMPONENT=commit_path and drawn both ways by the soak's
+    # randomize_commit_path(). Wire bytes are identical either way.
+    # schema-compiled struct encode/decode in net/wire.py (process-wide:
+    # the codec registry is module state)
+    WIRE_COMPILED_CODEC = True
+    # batch-settle reply/fan-out futures in one loop step
+    # (futures.settle_batch; process-wide module state)
+    FUTURE_SLAB_SETTLE = True
+    # tlog releases the version chain at DiskQueue push time, overlapping
+    # the next version's push with the in-flight write+fsync round; acks
+    # still wait for the covering round's fsync (server/tlog.py)
+    TLOG_FSYNC_PIPELINE = True
     # multi-region log routing
     ROUTER_BUFFER_BYTES = 1 << 20  # per-tag unacked relay buffer cap
     # data distribution (DataDistributionTracker.actor.cpp knobs
@@ -475,3 +488,19 @@ class Knobs:
             self.CLIENT_MULTIGET_MAX_KEYS = rng.random_choice([2, 64, 1024])
         if rng.coinflip(0.25):
             self.CLIENT_READ_PIPELINE_DEPTH = rng.random_choice([1, 2, 8])
+
+    def randomize_commit_path(self, rng) -> None:
+        """Commit-path knob randomization (ISSUE 18), drawn at the very
+        END of the soak's sequence (after randomize_prefilter) for the
+        pinned-seed reason shared by every post-PR-12 satellite: earlier
+        cluster-shape and workload-rotation draws must reproduce exactly.
+        Each mechanism is drawn both ways so the soak matrix covers the
+        legacy paths too — the compiled codec is byte-identical by
+        construction, slab settling only regroups wakeups, and the fsync
+        pipeline must hold the no-early-ack contract under chaos."""
+        if rng.coinflip(0.3):
+            self.WIRE_COMPILED_CODEC = rng.random_choice([True, False])
+        if rng.coinflip(0.3):
+            self.FUTURE_SLAB_SETTLE = rng.random_choice([True, False])
+        if rng.coinflip(0.3):
+            self.TLOG_FSYNC_PIPELINE = rng.random_choice([True, False])

@@ -60,6 +60,11 @@ class EventLoop:
         # constructors behind the RUN_LOOP_PROFILER knob; when present,
         # every callback executes under per-actor/per-band attribution
         self.profiler = None
+        # settle-slab hook (futures.settle_batch): while non-None, Task
+        # wakeups append (task, value, error) here instead of paying one
+        # call_soon per woken task; the installer flushes the slab as
+        # per-priority call_soon_batch entries
+        self._wake_collector = None
 
     def now(self) -> float:
         return self._time

@@ -36,6 +36,13 @@ PROTOCOL_VERSION = 0x0FDB00B070010009  # gen-9: proxy conflict pre-filter —
 #        ResolveBatchReply grows committed_ranges + version_floor
 #        (resolver→proxy summary feedback); the codec is positional, so a
 #        gen-8 peer would misparse the reply tail — handshake rejects it
+#
+# NOT a generation bump: the schema-compiled codec (net/wire.py,
+# WIRE_COMPILED_CODEC) emits byte-identical gen-9 frames — it changes how
+# structs are packed/unpacked, never what lands on the wire. The
+# tests/golden_wire.json fixture plus the fuzzed compiled-vs-interpretive
+# differential in tests/test_wire_codec.py enforce that equivalence; a
+# real field change still bumps the generation as before.
 
 
 class BinaryWriter:

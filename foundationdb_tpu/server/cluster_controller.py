@@ -703,6 +703,16 @@ class ClusterController:
                     default=0,
                 ),
             },
+            # tlog durability (ISSUE 18): physical fsync rounds vs group
+            # joins is the write-coalescing ratio ((rounds+joins)/rounds
+            # commits per physical fsync); pipeline_depth is the high-water
+            # count of commits overlapped behind an in-flight fsync round
+            "tlog": {
+                "fsync_rounds": agg("tlog", "fsyncRounds"),
+                "group_joins": agg("tlog", "groupJoins"),
+                "fsync_seconds": round(agg("tlog", "fsyncSeconds"), 3),
+                "pipeline_depth": agg("tlog", "pipelineDepth"),
+            },
             # watches + change feeds (ISSUE 16): fan-out evidence.
             # parked/bytes are CURRENT totals across storages (gauges);
             # fired/batches ratio is the per-version fan-out batching

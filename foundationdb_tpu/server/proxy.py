@@ -44,6 +44,7 @@ from ..runtime.futures import (
     RequestBatcher,
     VersionGate,
     delay,
+    settle_batch,
     timeout,
     wait_for_all,
     wait_for_any,
@@ -917,16 +918,23 @@ class Proxy:
         ):
             oracle.note_acked(version)
         _debug("Replied")
+        # batch-settle the whole batch's replies in one loop step
+        # (futures.settle_batch, ISSUE 18): a wide commit batch used to
+        # pay one wakeup per waiting txn actor here
+        settles = []
         for verdict, reply, stamp in zip(verdicts, replies, stamps):
             if verdict == Verdict.COMMITTED:
                 self._c_txn_committed.add()
-                reply._set(CommitReply(version=version, versionstamp=stamp))
+                settles.append(
+                    (reply, CommitReply(version=version, versionstamp=stamp), None)
+                )
             elif verdict == Verdict.TOO_OLD:
                 self._c_txn_too_old.add()
-                reply._set_error(TransactionTooOld())
+                settles.append((reply, None, TransactionTooOld()))
             else:
                 self._c_txn_conflict.add()
-                reply._set_error(NotCommitted())
+                settles.append((reply, None, NotCommitted()))
+        settle_batch(settles)
 
     def _apply_resolver_changes(self, vreq) -> None:
         """Boundary moves piggybacked on the version grant

@@ -26,7 +26,7 @@ from ..runtime.futures import AsyncVar, Future, VersionGate, delay
 from ..runtime.knobs import Knobs
 from ..runtime.buggify import buggify
 from ..runtime.stats import CounterCollection
-from ..runtime.loop import now
+from ..runtime.loop import Cancelled, now
 from ..runtime.trace import emit_span, span
 from .systemdata import TXS_TAG
 from .interfaces import (
@@ -40,6 +40,13 @@ from .interfaces import (
 )
 
 FSYNC_TIME = 0.0002  # simulated DiskQueue sync (SSD-class fsync)
+
+# named chaos site (runtime/buggify.py): stall INSIDE the pipelined-fsync
+# window — the version chain has been released at push time but the
+# covering fsync round has not returned, so a kill here is a crash with
+# successor versions already accumulating behind an unfinished round
+# (recovery must discard the whole unacked suffix; see test_tlog_trim)
+SITE_FSYNC_PIPELINE_STALL = ("server/tlog.py", "tlog-fsync-pipeline-stall")
 
 
 class Spilled:
@@ -134,6 +141,34 @@ class TLog:
             "queueBytes",
             lambda: self.dq.bytes_used if self.dq is not None else 0,
         )
+        # durability observability (ISSUE 18): fsyncRounds vs groupJoins is
+        # the write-coalescing ratio ((rounds+joins)/rounds commits per
+        # physical fsync); fsyncSeconds is cumulative time inside
+        # write+fsync rounds; pipelineDepth is the high-water number of
+        # version commits overlapped behind an in-flight fsync round
+        self._modeled_fsyncs = 0
+        self._modeled_fsync_s = 0.0
+        self._pipeline_peak = 0
+        self.stats.gauge(
+            "fsyncRounds",
+            lambda: self.dq.commits
+            if self.dq is not None
+            else self._modeled_fsyncs,
+        )
+        self.stats.gauge(
+            "groupJoins",
+            lambda: self.dq.group_joins if self.dq is not None else 0,
+        )
+        self.stats.gauge(
+            "fsyncSeconds",
+            lambda: round(
+                self.dq.fsync_seconds
+                if self.dq is not None
+                else self._modeled_fsync_s,
+                6,
+            ),
+        )
+        self.stats.gauge("pipelineDepth", lambda: self._pipeline_peak)
 
     async def recover(self) -> None:
         """Rebuild from the DiskQueue after a reboot
@@ -189,8 +224,6 @@ class TLog:
             # fenced while waiting: must not make this durable/acked — the
             # recovery already chose an end version without it
             raise TLogStopped(f"tlog {self.log_id} locked at {self.locked_by_epoch}")
-        if req.version <= self._gate.version:
-            return None  # duplicate (proxy retransmit): already durable
         dup = self._pending.get(req.version)
         if dup is not None:
             # appended and mid-fsync: a second append would double-apply at
@@ -198,6 +231,15 @@ class TLog:
             # exist yet — wait for the original's fsync
             await dup
             return None
+        if req.version <= self._gate.version:
+            # under pipelined fsync the gate is released at PUSH time, so a
+            # past-gate version is provably durable only once the durable
+            # high-water covers it; a retransmit landing in the gap left by
+            # a cancelled push (appended, never fsynced) must not be acked
+            if req.version <= self.version.get():
+                return None  # duplicate (proxy retransmit): already durable
+            raise Cancelled()
+        pipeline = bool(getattr(self.knobs, "TLOG_FSYNC_PIPELINE", True))
         durable = self._pending[req.version] = Future()
         try:
             msgs = {
@@ -223,10 +265,33 @@ class TLog:
                 if msgs:
                     self._entry_bytes[req.version] = len(payload)
                     self._mem_bytes += len(payload)
+                if pipeline:
+                    # cross-commit group commit (ISSUE 18): release the
+                    # version chain at push time — the in-memory append
+                    # order already fixes this version's place, so the
+                    # NEXT version's push can accumulate into the dq while
+                    # this round's write+fsync is in flight (latecomers
+                    # park on the active round and join the next one,
+                    # which is how batches widen under load). The ack
+                    # below still waits for the covering round's fsync.
+                    self._gate.advance_to(req.version)
+                    depth = len(self._pending)
+                    if depth > self._pipeline_peak:
+                        self._pipeline_peak = depth
+                    if buggify(SITE_FSYNC_PIPELINE_STALL):
+                        await delay(0.004)  # widen the unfsynced window
                 await self.dq.commit()
             else:
                 # modeled DiskQueue push + fsync
-                await delay(getattr(self.knobs, "TLOG_FSYNC_TIME", FSYNC_TIME))
+                if pipeline:
+                    self._gate.advance_to(req.version)
+                    depth = len(self._pending)
+                    if depth > self._pipeline_peak:
+                        self._pipeline_peak = depth
+                fsync_s = getattr(self.knobs, "TLOG_FSYNC_TIME", FSYNC_TIME)
+                await delay(fsync_s)
+                self._modeled_fsyncs += 1
+                self._modeled_fsync_s += fsync_s
             durable._set(None)
         finally:
             # on cancellation (process kill) the version must not stay
@@ -235,8 +300,6 @@ class TLog:
             # duplicate parked on ``durable`` must not hang either
             self._pending.pop(req.version, None)
             if not durable.is_ready():
-                from ..runtime.loop import Cancelled
-
                 durable._set_error(Cancelled())
         if self.stopped:
             # durable, but past the fence: never ack (the client sees

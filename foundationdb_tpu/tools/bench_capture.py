@@ -87,6 +87,31 @@ def run_bench(timeout=2400):
     return result
 
 
+def codec_micro(timeout=120):
+    """Wire-codec micro numbers (perf --codec-micro): CPU-only and cheap,
+    captured fresh with each snapshot so the BENCH JSON carries the
+    codec's isolated contribution (msgs/s both paths + the byte-identity
+    check) next to the kernel/run_loop evidence (ISSUE 18 satellite)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.tools.perf",
+             "--codec-micro"],
+            capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log("codec micro timed out")
+        return None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except ValueError:
+                pass
+    return None
+
+
 def snapshot(result, platform):
     """Merge a device-verified result into BENCH_partial.json (keep best)."""
     best = None
@@ -100,6 +125,9 @@ def snapshot(result, platform):
     entry["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     entry["device"] = platform
     entry["capture"] = "bench_capture daemon (driver-verifiable snapshot)"
+    micro = codec_micro()
+    if micro:
+        entry["codec_micro"] = micro
     if best and best.get("vs_baseline", 0) > entry.get("vs_baseline", 0):
         best["superseded_attempt"] = {
             "vs_baseline": entry.get("vs_baseline"),
@@ -192,6 +220,21 @@ def snapshot(result, platform):
     # run-loop profiler provenance (perf embeds the snapshot next to the
     # kernel counters): a capture whose loop spent half its time in host
     # encode or paid SlowTask stalls says so next to its number
+    # wire-codec provenance (perf --codec-micro): the compiled codec's
+    # isolated encode/decode speedups plus the byte-identity verdict,
+    # next to the e2e number they feed (ISSUE 18)
+    cm = entry.get("codec_micro") or {}
+    if cm:
+        log(
+            "codec: encode x%s decode x%s compiled "
+            "(%s msgs/round, byte_identical=%s)"
+            % (
+                cm.get("encode_speedup"),
+                cm.get("decode_speedup"),
+                cm.get("messages_per_round"),
+                cm.get("byte_identical"),
+            )
+        )
     rl = entry.get("run_loop") or {}
     if rl:
         hot = ", ".join(

@@ -182,6 +182,16 @@ class FdbCli:
                 + (f", oldest {age:.1f}s" if age else "")
                 + ")"
             )
+        tl = (doc.get("workload") or {}).get("tlog") or {}
+        if tl.get("fsync_rounds"):
+            rounds = tl.get("fsync_rounds") or 0
+            joins = tl.get("group_joins") or 0
+            lines.append(
+                f"TLog: {rounds} fsync rounds, {joins} group joins "
+                f"({(rounds + joins) / max(rounds, 1):.1f} commits/round), "
+                f"{tl.get('fsync_seconds') or 0:.2f}s in fsync, "
+                f"pipeline depth {tl.get('pipeline_depth') or 0}"
+            )
         wa = (doc.get("workload") or {}).get("watches") or {}
         if (wa.get("registered") or {}).get("counter") or wa.get("parked_now"):
             fired = (wa.get("fired") or {}).get("counter") or 0

@@ -281,8 +281,28 @@ def run_one(
             ),
         )
     knobs.randomize_prefilter(shape_rng)
+    # commit-path draws (ISSUE 18) are the NEW end of the sequence — after
+    # randomize_prefilter, so every pinned seed's earlier draws reproduce
+    # byte-identically. The codec and slab knobs toggle process-global
+    # module state (net/wire.py, runtime/futures.py): the sim transport
+    # passes objects by reference so the codec is inert here, but slab
+    # settling regroups GRV/commit fan-out wakeups inside the sim, and the
+    # fsync pipeline reorders the tlog's gate release — both must hold
+    # their contracts (no early ack, no lost wakeups) under kill/rollback
+    # chaos. Restored to defaults after the run so soak state never leaks
+    # into the next seed or test.
+    knobs.randomize_commit_path(shape_rng)
+    from ..net import wire as _wire
+    from ..runtime import futures as _futures
 
-    sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
+    _wire.set_compiled_codec(bool(knobs.WIRE_COMPILED_CODEC))
+    _futures.set_slab_settle(bool(knobs.FUTURE_SLAB_SETTLE))
+
+    try:
+        sim.run_until_done(spawn(run_workloads(workloads)), 1800.0)
+    finally:
+        _wire.set_compiled_codec(True)
+        _futures.set_slab_settle(True)
     # zero-false-rejection acceptance (ISSUE 17): the oracle raises at
     # the offending rejection already; this catches a swallowed raise
     pf_oracle = sim.prefilter_oracle
@@ -310,6 +330,11 @@ def run_one(
         "overload_armed": bool(overload),
         "prefilter_armed": bool(knobs.PROXY_CONFLICT_PREFILTER),
         "prefilter_rejections_checked": pf_oracle.rejections_checked,
+        "commit_path_armed": {
+            "compiled_codec": bool(knobs.WIRE_COMPILED_CODEC),
+            "slab_settle": bool(knobs.FUTURE_SLAB_SETTLE),
+            "fsync_pipeline": bool(knobs.TLOG_FSYNC_PIPELINE),
+        },
         "workloads": [type(w).__name__ for w in workloads],
         "config": cfg.as_dict(),
     }

@@ -96,6 +96,43 @@ def test_conformance_long_stream():
     assert list(log_real) == list(log_model)
 
 
+def test_conformance_commit_path_knobs_both_ways():
+    """ISSUE 18 acceptance: the commit-path fast paths (compiled wire
+    codec, slab-settled futures, pipelined tlog fsync) are pure perf —
+    the same seeded stream must yield byte-identical final data and stack
+    logs with all three knobs forced on and forced off, and both must
+    match the model oracle."""
+    from foundationdb_tpu.net import wire
+    from foundationdb_tpu.runtime import futures as rt_futures
+    from foundationdb_tpu.runtime.knobs import Knobs
+
+    results = {}
+    for legacy in (False, True):
+        knobs = Knobs()
+        knobs.WIRE_COMPILED_CODEC = not legacy
+        knobs.FUTURE_SLAB_SETTLE = not legacy
+        knobs.TLOG_FSYNC_PIPELINE = not legacy
+        # sim clusters read TLOG_FSYNC_PIPELINE off sim.knobs; the codec
+        # and settle paths are process-global toggles
+        wire.set_compiled_codec(not legacy)
+        rt_futures.set_slab_settle(not legacy)
+        try:
+            stream, (data, log) = run_real(7, 600, knobs=knobs)
+        finally:
+            wire.set_compiled_codec(True)
+            rt_futures.set_slab_settle(True)
+        results[legacy] = (stream, list(data), list(log))
+    assert results[False][1] == results[True][1], (
+        "final data diverged between fast and legacy commit paths"
+    )
+    assert results[False][2] == results[True][2], (
+        "stack logs diverged between fast and legacy commit paths"
+    )
+    data_model, log_model = run_model(results[False][0])
+    assert results[False][1] == list(data_model)
+    assert results[False][2] == list(log_model)
+
+
 def test_error_tuples_surface_conflicts():
     """A forced conflict between two named transactions must surface as
     the packed ('ERROR', '1020') tuple on BOTH sides at the same stream

@@ -101,6 +101,24 @@ def test_rpc_endpoints_open_spans_or_are_exempted_inline():
     )
 
 
+def test_wire_codec_registry_not_stale():
+    """Codec staleness gate (ISSUE 18 satellite): every registered wire
+    struct must carry a compiled encoder/decoder generated from the SAME
+    class object and field list that is currently registered. A schema
+    edit that skips re-registration (or a re-registration that skips
+    recompilation) would silently fall back to — or worse, disagree with —
+    the interpretive codec; `codec_audit()` turns that into a tier-1
+    failure. The flag/near-miss fixtures for each staleness mode live in
+    test_wire_codec.py; this is the tree-level clean check."""
+    from foundationdb_tpu.net import wire
+
+    problems = wire.codec_audit()
+    assert not problems, (
+        "stale compiled wire codecs (re-run register_struct after schema "
+        "edits):\n  " + "\n  ".join(problems)
+    )
+
+
 # ---------------------------------------------------------------------------
 # Fixture tests: the old assertions, replayed as flag/near-miss trees
 # against the new rules (coverage must not shrink in the migration).

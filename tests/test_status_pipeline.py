@@ -92,6 +92,14 @@ def test_status_pipeline_end_to_end():
     assert wl["prefiltered"]["counter"] >= 0
     assert wl["prefilter"]["checks"]["counter"] >= 0
 
+    # -- tlog durability (ISSUE 18): the section must aggregate the
+    # actual tlog roles' counters (kind is the lowercase recruit kind),
+    # so a cluster that committed transactions shows fsync rounds
+    tl = wl["tlog"]
+    assert tl["fsync_rounds"] > 0, tl
+    assert tl["fsync_seconds"] >= 0 and tl["group_joins"] >= 0, tl
+    assert tl["pipeline_depth"] >= 0, tl
+
     # -- qos: totals + ratekeeper rate + durability-lag roll-up
     qos = doc["qos"]
     assert qos["transactions_committed_total"] >= 28

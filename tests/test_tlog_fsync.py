@@ -1,0 +1,240 @@
+"""Pipelined group-commit fsync (ISSUE 18): the tlog may overlap the
+next version's push with an in-flight write+fsync round, but the
+durability contract is unchanged — a commit is ACKED only after the
+round covering it returns from fsync.
+
+Layers covered:
+ - overlap: N chained commits complete in ~1 fsync's worth of sim time
+   with the pipeline on vs ~N fsyncs with it off (the knob A/B), and
+   pipelineDepth records the overlap;
+ - no early ack: with the physical sync parked, the version gate has
+   released (pushes accumulated) but no commit future is ready, the
+   durable version has not moved, and peeks clamp below the unfsynced
+   entries;
+ - retransmit in the pushed-but-unfsynced gap: a duplicate of a version
+   past the gate but above the durable floor must not be acked as
+   "already durable";
+ - crash during pipelined fsync (the SITE_FSYNC_PIPELINE_STALL chaos
+   site held open): kill semantics drop unsynced writes, and a fresh
+   tlog recovered from the same disk must still serve EVERY version that
+   was acked before the crash.
+"""
+
+import pytest
+
+from foundationdb_tpu.net.sim import Sim
+from foundationdb_tpu.runtime.buggify import Buggify, set_buggify
+from foundationdb_tpu.runtime.futures import Future, delay, spawn, wait_for_all
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.loop import now
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.server.interfaces import (
+    TLogCommitRequest,
+    TLogPeekRequest,
+)
+from foundationdb_tpu.server.tlog import SITE_FSYNC_PIPELINE_STALL, TLog
+
+
+def run(coro, seed=7, limit=60.0):
+    sim = Sim(seed=seed)
+    sim.activate()
+    return sim.run_until_done(spawn(coro), limit)
+
+
+def commit_req(v, tag=0):
+    from foundationdb_tpu.kv.mutations import Mutation, MutationType
+
+    return TLogCommitRequest(
+        epoch=0,
+        prev_version=v - 1,
+        version=v,
+        messages={
+            tag: [Mutation(MutationType.SET_VALUE, b"k%04d" % v, b"v%04d" % v)]
+        },
+        known_committed=0,
+    )
+
+
+def chained_commits(tl, n):
+    """Spawn n version-chained commits concurrently (the proxy shape:
+    many in flight, the tlog's gate sequences them)."""
+    return [spawn(tl.commit(commit_req(v))) for v in range(1, n + 1)]
+
+
+# ---------------------------------------------------------------------------
+# overlap: the knob A/B on the modeled-fsync path
+
+
+@pytest.mark.parametrize("pipeline", [True, False], ids=["on", "off"])
+def test_pipeline_overlaps_modeled_fsync(pipeline):
+    fsync_s = 0.01
+    n = 8
+    result = {}
+
+    async def body():
+        knobs = Knobs()
+        knobs.TLOG_FSYNC_TIME = fsync_s
+        knobs.TLOG_FSYNC_PIPELINE = pipeline
+        tl = TLog(log_id="tp", knobs=knobs)
+        t0 = now()
+        futs = chained_commits(tl, n)
+        await wait_for_all(futs)
+        result["elapsed"] = now() - t0
+        result["peak"] = tl._pipeline_peak
+        assert tl.version.get() == n
+
+    run(body())
+    if pipeline:
+        # every commit's modeled fsync overlaps: ~1 fsync total, and the
+        # pending-slab depth saw the overlap
+        assert result["elapsed"] < 2 * fsync_s, result
+        assert result["peak"] > 1, result
+    else:
+        # serialized: the version chain holds each commit until the
+        # previous fsync returned
+        assert result["elapsed"] >= n * fsync_s * 0.99, result
+        assert result["peak"] == 0, result
+
+
+# ---------------------------------------------------------------------------
+# no early ack: park the physical sync, watch the gate run ahead
+
+
+def test_ack_waits_for_covering_fsync_on_disk():
+    sim = Sim(seed=11)
+    sim.activate()
+
+    async def body():
+        tl = TLog(log_id="td", disk=sim.disk("m0"))
+        await tl.commit(commit_req(1))  # opens the queue file
+        assert tl.version.get() == 1
+
+        f = tl.dq._file
+        real_sync = f.sync
+        hold = Future()
+
+        async def parked_sync():
+            await hold
+            await real_sync()
+
+        f.sync = parked_sync
+        try:
+            c2 = spawn(tl.commit(commit_req(2)))
+            c3 = spawn(tl.commit(commit_req(3)))
+            await delay(0.05)
+            # pipelined: both versions pushed, version chain released...
+            assert tl._gate.version == 3
+            # ...but NOTHING acked and the durable horizon unmoved
+            assert not c2.is_ready() and not c3.is_ready()
+            assert tl.version.get() == 1
+            # peeks clamp at the durable version: unfsynced entries are
+            # never served to storage (begin=2 would long-poll on the
+            # durable horizon, which is exactly the point)
+            reply = await tl.peek(TLogPeekRequest(tag=0, begin=1))
+            assert [v for v, _m in reply.messages] == [1]
+            assert reply.end_version == 1
+        finally:
+            f.sync = real_sync
+            hold._set(None)
+        await wait_for_all([c2, c3])
+        assert tl.version.get() == 3
+        reply = await tl.peek(TLogPeekRequest(tag=0, begin=2))
+        assert [v for v, _m in reply.messages] == [2, 3]
+
+    sim.run_until_done(spawn(body()), 60.0)
+
+
+def test_retransmit_in_unfsynced_gap_not_acked():
+    """A proxy retransmit for a version the gate has passed but the
+    durable horizon has not must NOT be answered as a duplicate-of-
+    durable — that would ack data that can still be lost."""
+    from foundationdb_tpu.runtime.loop import Cancelled
+
+    sim = Sim(seed=13)
+    sim.activate()
+
+    async def body():
+        tl = TLog(log_id="tr", disk=sim.disk("m0"))
+        await tl.commit(commit_req(1))
+        # simulate the gap a cancelled push leaves: gate past v2, durable
+        # floor still at v1, no pending future for v2
+        tl._gate.advance_to(2)
+        with pytest.raises(Cancelled):
+            await tl.commit(commit_req(2))
+        # a version at or below the durable floor IS a safe duplicate
+        assert await tl.commit(commit_req(1)) is None
+
+    sim.run_until_done(spawn(body()), 60.0)
+
+
+# ---------------------------------------------------------------------------
+# crash during pipelined fsync → recovery serves every acked version
+
+
+def test_crash_during_pipelined_fsync_preserves_acked():
+    """The SITE_FSYNC_PIPELINE_STALL chaos window held open (buggify
+    pinned to always-fire widens the pushed-but-unfsynced gap), then a
+    kill drops unsynced writes. The recovered tlog must serve every
+    version acked before the crash; versions never acked may go either
+    way."""
+    sim = Sim(seed=17)
+    # run_until_done re-activates the sim (reinstalling sim.buggify), so
+    # force the chaos site by replacing the sim's own instance
+    sim.buggify = Buggify(DeterministicRandom(17), p_enabled=1.0, p_fire=1.0)
+    sim.activate()
+    try:
+        disk = sim.disk("m0")
+        acked = []
+
+        async def crash_run():
+            tl = TLog(log_id="tc", disk=disk)
+            futs = chained_commits(tl, 12)
+            # wait until a prefix is acked, then "crash" with the rest
+            # mid-pipeline (the stall site keeps rounds in flight)
+            while tl.version.get() < 4:
+                await delay(0.001)
+            for v, f in enumerate(futs, start=1):
+                if f.is_ready() and not f.is_error():
+                    acked.append(v)
+            for f in futs:
+                f.cancel()
+            return True
+
+        sim.run_until_done(spawn(crash_run()), 60.0)
+        disk.on_kill()  # unsynced writes lost (AsyncFileNonDurable)
+        assert acked, "crash landed before any ack — test shape broken"
+
+        async def recover_run():
+            tl2 = TLog(log_id="tc", disk=disk)
+            await tl2.recover()
+            # every acked version is present and peekable
+            assert tl2.version.get() >= max(acked)
+            reply = await tl2.peek(TLogPeekRequest(tag=0, begin=1))
+            got = {v for v, _m in reply.messages}
+            missing = [v for v in acked if v not in got]
+            assert not missing, f"acked versions lost by crash: {missing}"
+            return True
+
+        sim.run_until_done(spawn(recover_run()), 60.0)
+    finally:
+        set_buggify(Buggify(None))
+
+
+def test_stall_site_fires_under_forced_buggify():
+    """The named chaos site is actually reachable on the dq commit path
+    (soak's fired-site report keys on it)."""
+    sim = Sim(seed=19)
+    b = Buggify(DeterministicRandom(19), p_enabled=1.0, p_fire=1.0)
+    sim.buggify = b
+    sim.activate()
+    try:
+
+        async def body():
+            tl = TLog(log_id="tf", disk=sim.disk("m0"))
+            await wait_for_all(chained_commits(tl, 3))
+            return True
+
+        sim.run_until_done(spawn(body()), 60.0)
+        assert SITE_FSYNC_PIPELINE_STALL in b.fired
+    finally:
+        set_buggify(Buggify(None))

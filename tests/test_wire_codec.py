@@ -1,0 +1,439 @@
+"""Schema-compiled wire codec (ISSUE 18): byte identity, staleness gate,
+slab-settled futures.
+
+Layers covered:
+ - golden-bytes fixture (tests/golden_wire.json): canonical encodings
+   every registered struct must reproduce BYTE-FOR-BYTE on both codec
+   paths — the cross-version regression tripwire (a codegen change that
+   alters even one length byte fails here before it bricks a mixed-
+   version cluster). Regen with:  python tests/test_wire_codec.py --regen
+ - fuzzed differential: random field trees through compiled vs
+   interpretive encode must be identical bytes; decode must reproduce
+   the fields exactly (compared field-wise, never via repr — enum-typed
+   fields legitimately hold plain ints under fuzz and some __repr__s
+   assume the enum);
+ - codec_audit(): the staleness gate is clean on the real registry and
+   actually fires on each failure mode (missing codec, stale class
+   binding, field drift, missing encoder);
+ - settle_batch(): one loop step settles many futures, error and value
+   mixed, priority order preserved, nested cascades collected, and the
+   off-path (FUTURE_SLAB_SETTLE=false) stays per-waiter.
+"""
+
+import dataclasses
+import json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from foundationdb_tpu.net import wire
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden_wire.json")
+
+
+def registered_dataclasses():
+    """Every register_struct dataclass (register_custom pack/unpack pairs
+    carry hand-written codecs and are exempt, as in codec_audit)."""
+    return {
+        name: entry
+        for name, entry in sorted(wire._struct_by_name.items())
+        if isinstance(entry, type)
+    }
+
+
+# deterministic per-field synthesis for the golden fixture. No sets and
+# no float NaN: set iteration order depends on PYTHONHASHSEED for
+# bytes/str members, and NaN != NaN breaks round-trip comparison.
+_SYNTH_POOL = [
+    0,
+    -1,
+    4095,          # top of the small-int cache
+    4096,          # first uncached int
+    -129,          # below the cache
+    1 << 70,       # multi-byte little-endian body
+    b"",
+    b"key/000042",
+    b"\x00\xff" * 3,
+    "",
+    "name-7",
+    "uni-☃",
+    None,
+    True,
+    False,
+    1.5,
+    -2.25,
+    (1, b"a", "b"),
+    [b"k", None, 3],
+    {b"k": 1, "s": b"v"},
+]
+
+
+def synth_value(i):
+    return _SYNTH_POOL[i % len(_SYNTH_POOL)]
+
+
+def canonical_instance(cls):
+    flds = dataclasses.fields(cls)
+    return cls(*[synth_value(i) for i in range(len(flds))])
+
+
+def hot_messages():
+    """Realistic commit/read-path messages (enums, nested structs,
+    mutation lists) — the shapes a loaded cluster actually moves."""
+    from foundationdb_tpu.tools.perf import _hot_message_set
+
+    return _hot_message_set()
+
+
+def fields_equal(a, b):
+    """Field-wise equality without repr: fuzzed instances may hold plain
+    ints in enum-typed fields, and some __repr__s assume the enum."""
+    if a.__class__ is not b.__class__:
+        return False
+    for fl in dataclasses.fields(a):
+        if getattr(a, fl.name) != getattr(b, fl.name):
+            return False
+    return True
+
+
+@pytest.fixture
+def both_codecs():
+    """Restore the compiled codec after any test that toggles it."""
+    yield
+    wire.set_compiled_codec(True)
+
+
+# ---------------------------------------------------------------------------
+# golden-bytes fixture
+
+
+def build_golden():
+    entries = {}
+    wire.set_compiled_codec(True)
+    try:
+        for name, cls in registered_dataclasses().items():
+            inst = canonical_instance(cls)
+            entries[name] = {
+                "fields": [fl.name for fl in dataclasses.fields(cls)],
+                "hex": wire.encode_value(inst).hex(),
+            }
+        hot = [wire.encode_value(m).hex() for m in hot_messages()]
+    finally:
+        wire.set_compiled_codec(True)
+    return {"format": "gen-9", "structs": entries, "hot": hot}
+
+
+def test_golden_fixture_exists_and_covers_registry():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    missing = set(registered_dataclasses()) - set(golden["structs"])
+    assert not missing, (
+        f"structs with no golden encoding (regen: python "
+        f"tests/test_wire_codec.py --regen): {sorted(missing)}"
+    )
+
+
+@pytest.mark.parametrize("compiled", [True, False], ids=["compiled", "interp"])
+def test_golden_bytes_reproduced(compiled, both_codecs):
+    """Both codec paths must reproduce the checked-in bytes exactly. A
+    diff here is a WIRE FORMAT CHANGE: it needs a protocol version bump
+    and a deliberate fixture regen, not a silent update."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    wire.set_compiled_codec(compiled)
+    regd = registered_dataclasses()
+    for name, entry in golden["structs"].items():
+        cls = regd.get(name)
+        if cls is None:
+            continue  # struct removed; coverage test owns the inverse
+        if [fl.name for fl in dataclasses.fields(cls)] != entry["fields"]:
+            pytest.fail(
+                f"{name}: field list drifted from golden fixture — wire "
+                f"format change, bump PROTOCOL_VERSION and regen"
+            )
+        inst = canonical_instance(cls)
+        got = wire.encode_value(inst)
+        assert got.hex() == entry["hex"], f"{name}: bytes drifted"
+        back = wire.decode_value(got)
+        assert fields_equal(back, inst), f"{name}: decode round-trip"
+    for want, msg in zip(golden["hot"], hot_messages()):
+        assert wire.encode_value(msg).hex() == want
+
+
+# ---------------------------------------------------------------------------
+# fuzzed differential: compiled vs interpretive
+
+
+def fuzz_value(rnd, depth=0):
+    roll = rnd.random()
+    if depth >= 2 or roll < 0.55:
+        return rnd.choice(
+            [
+                rnd.randrange(-(1 << 40), 1 << 40),
+                rnd.randrange(-128, 4096),
+                rnd.randbytes(rnd.randrange(0, 40)),
+                "".join(chr(rnd.randrange(32, 0x2FF)) for _ in range(rnd.randrange(8))),
+                None,
+                bool(rnd.getrandbits(1)),
+                rnd.random() * 1e6,
+            ]
+        )
+    if roll < 0.7:
+        return tuple(fuzz_value(rnd, depth + 1) for _ in range(rnd.randrange(3)))
+    if roll < 0.85:
+        return [fuzz_value(rnd, depth + 1) for _ in range(rnd.randrange(3))]
+    return {
+        rnd.randbytes(4): fuzz_value(rnd, depth + 1)
+        for _ in range(rnd.randrange(3))
+    }
+
+
+def test_fuzzed_differential_all_structs(both_codecs):
+    """Random field trees through every registered struct: compiled and
+    interpretive encodings must be the same bytes, and decode must give
+    back the same fields (bytes and memoryview readers both)."""
+    rnd = random.Random(1807)
+    mismatches = []
+    for name, cls in registered_dataclasses().items():
+        for trial in range(8):
+            flds = dataclasses.fields(cls)
+            inst = cls(*[fuzz_value(rnd) for _ in flds])
+            wire.set_compiled_codec(True)
+            comp = wire.encode_value(inst)
+            dec_c = wire.decode_value(comp)
+            wire.set_compiled_codec(False)
+            interp = wire.encode_value(inst)
+            dec_i = wire.decode_value(interp)
+            if comp != interp:
+                mismatches.append(f"{name}[{trial}]: bytes differ")
+            elif not fields_equal(dec_c, inst) or not fields_equal(dec_i, inst):
+                mismatches.append(f"{name}[{trial}]: decode mismatch")
+    assert not mismatches, mismatches[:10]
+
+
+def test_differential_hot_messages_and_memoryview(both_codecs):
+    for msg in hot_messages():
+        wire.set_compiled_codec(True)
+        comp = wire.encode_value(msg)
+        wire.set_compiled_codec(False)
+        assert wire.encode_value(msg) == comp
+        wire.set_compiled_codec(True)
+        # the zero-copy super-frame path hands decode a memoryview
+        assert fields_equal(wire.decode_value(memoryview(comp)), msg)
+        assert fields_equal(wire.decode_value(comp), msg)
+
+
+def test_knob_toggle_via_realworld_settings():
+    assert wire.compiled_codec_enabled()
+    wire.set_compiled_codec(False)
+    assert not wire.compiled_codec_enabled()
+    wire.set_compiled_codec(True)
+    assert wire.compiled_codec_enabled()
+
+
+# ---------------------------------------------------------------------------
+# codec_audit staleness gate
+
+
+def test_codec_audit_clean_on_real_registry():
+    assert wire.codec_audit() == []
+
+
+def test_codec_audit_fires_on_missing_codec():
+    name = "GetValueRequest"
+    saved = wire._COMPILED_META.pop(name)
+    try:
+        assert any("no compiled codec" in p for p in wire.codec_audit())
+    finally:
+        wire._COMPILED_META[name] = saved
+
+
+def test_codec_audit_fires_on_stale_class_binding():
+    """A registry poke that bypasses register_struct (rebinding the name
+    to a new class) leaves the codec compiled against the OLD class."""
+    name = "GetValueRequest"
+    saved = wire._struct_by_name[name]
+
+    @dataclasses.dataclass
+    class GetValueRequest:
+        key: bytes = b""
+        version: int = -1
+
+    wire._struct_by_name[name] = GetValueRequest
+    try:
+        assert any("stale class" in p for p in wire.codec_audit())
+    finally:
+        wire._struct_by_name[name] = saved
+    assert wire.codec_audit() == []
+
+
+def test_codec_audit_fires_on_field_drift():
+    name = "GetValueRequest"
+    cls, fields = wire._COMPILED_META[name]
+    wire._COMPILED_META[name] = (cls, fields[:-1])
+    try:
+        assert any("drifted" in p for p in wire.codec_audit())
+    finally:
+        wire._COMPILED_META[name] = (cls, fields)
+
+
+def test_codec_audit_fires_on_missing_decoder():
+    name = "GetValueRequest"
+    saved = wire._COMPILED_DEC.pop(name)
+    try:
+        assert any("missing" in p for p in wire.codec_audit())
+    finally:
+        wire._COMPILED_DEC[name] = saved
+
+
+def test_reregister_heals_field_drift():
+    """register_struct IS the schema-compilation step: re-registering a
+    drifted class regenerates the codec and the audit goes clean."""
+    name = "GetValueRequest"
+    cls, fields = wire._COMPILED_META[name]
+    wire._COMPILED_META[name] = (cls, ("bogus",))
+    assert wire.codec_audit() != []
+    wire.register_struct(cls)
+    assert wire.codec_audit() == []
+
+
+# ---------------------------------------------------------------------------
+# slab-settled futures
+
+
+def run_sim(fn):
+    from foundationdb_tpu.net.sim import Sim
+    from foundationdb_tpu.runtime.futures import spawn
+
+    sim = Sim(seed=7)
+    sim.activate()
+    fut = spawn(fn())
+    sim.run_until_done(fut, 60.0)
+    return fut.get()
+
+
+def test_settle_batch_settles_many_waiters_in_one_step():
+    from foundationdb_tpu.runtime import futures as ft
+
+    async def body():
+        waiters = [ft.Future() for i in range(6)]
+        order = []
+
+        async def wait_on(i, f):
+            order.append((i, await f))
+
+        tasks = [ft.spawn(wait_on(i, f)) for i, f in enumerate(waiters)]
+        await ft.delay(0.01)  # everyone parked on its future
+        ft.settle_batch([(f, i * 10, None) for i, f in enumerate(waiters)])
+        await ft.wait_for_all(tasks)
+        return order
+
+    assert run_sim(lambda: body()) == [(i, i * 10) for i in range(6)]
+
+
+def test_settle_batch_mixed_values_and_errors():
+    from foundationdb_tpu.runtime import futures as ft
+
+    async def body():
+        ok, bad = ft.Future(), ft.Future()
+        results = {}
+
+        async def wait_ok():
+            results["ok"] = await ok
+
+        async def wait_bad():
+            try:
+                await bad
+            except RuntimeError as e:
+                results["bad"] = str(e)
+
+        t1, t2 = ft.spawn(wait_ok()), ft.spawn(wait_bad())
+        await ft.delay(0.01)
+        ft.settle_batch([(ok, 42, None), (bad, None, RuntimeError("boom"))])
+        await ft.wait_for_all([t1, t2])
+        return results
+
+    assert run_sim(lambda: body()) == {"ok": 42, "bad": "boom"}
+
+
+def test_settle_batch_nested_cascade_collected():
+    """A waiter that settles ANOTHER future from inside its continuation
+    must not deadlock or drop the nested wakeup."""
+    from foundationdb_tpu.runtime import futures as ft
+
+    async def body():
+        first, second = ft.Future(), ft.Future()
+        got = []
+
+        async def one():
+            got.append(await first)
+            second._set("cascade")
+
+        async def two():
+            got.append(await second)
+
+        t1, t2 = ft.spawn(one()), ft.spawn(two())
+        await ft.delay(0.01)
+        ft.settle_batch([(first, "root", None)])
+        await ft.wait_for_all([t1, t2])
+        return got
+
+    assert run_sim(lambda: body()) == ["root", "cascade"]
+
+
+def test_settle_batch_respects_disable_knob():
+    from foundationdb_tpu.runtime import futures as ft
+
+    async def body():
+        ft.set_slab_settle(False)
+        try:
+            assert not ft.slab_settle_enabled()
+            waiters = [ft.Future() for i in range(3)]
+            got = []
+
+            async def wait_on(f):
+                got.append(await f)
+
+            tasks = [ft.spawn(wait_on(f)) for f in waiters]
+            await ft.delay(0.01)
+            ft.settle_batch([(f, i, None) for i, f in enumerate(waiters)])
+            await ft.wait_for_all(tasks)
+            return got
+        finally:
+            ft.set_slab_settle(True)
+
+    assert run_sim(lambda: body()) == [0, 1, 2]
+
+
+def test_settle_batch_skips_already_ready_futures():
+    from foundationdb_tpu.runtime import futures as ft
+
+    async def body():
+        f = ft.Future()
+        f._set("already")
+        g = ft.Future()
+        # re-settling a ready future is a no-op (as with _set), not a crash
+        ft.settle_batch([(f, "clobbered", None), (g, "set", None)])
+        ft.settle_batch([])  # empty batch: no collector install, no step
+        return (await f, await g)
+
+    assert run_sim(lambda: body()) == ("already", "set")
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        golden = build_golden()
+        with open(GOLDEN, "w") as f:
+            json.dump(golden, f, indent=1)
+            f.write("\n")
+        print(
+            f"wrote {GOLDEN}: {len(golden['structs'])} structs, "
+            f"{len(golden['hot'])} hot messages"
+        )
+    else:
+        sys.exit(pytest.main([__file__, "-q"]))
