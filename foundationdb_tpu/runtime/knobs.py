@@ -213,6 +213,25 @@ class Knobs:
     TRACE_SAMPLE_RATE = 0.0
     LATENCY_PROBE_INTERVAL = 1.0  # CC's timed GRV/read/commit probe cadence
     METRICS_TRACE_INTERVAL = 5.0  # per-role CounterCollection trace cadence
+    # keyspace telemetry (ISSUE 20, server/storage_metrics.py): sampled
+    # byte/bandwidth estimation + read-hot-range detection on every
+    # storage server (StorageMetrics.actor.h byteSample/getReadHotRanges)
+    STORAGE_METRICS_SAMPLING = True
+    STORAGE_BYTE_SAMPLE_FACTOR = 200  # P(sample a set) = size/FACTOR, capped at 1
+    STORAGE_READ_SAMPLE_FACTOR = 400  # same for read-byte sampling
+    STORAGE_READ_SAMPLE_MAX_KEYS = 4096  # read sample cap (smallest-weight eviction)
+    STORAGE_METRICS_WINDOW = 5.0  # bandwidth/ops rolling-window width (s)
+    STORAGE_HOT_RANGE_BUCKET_SAMPLES = 8  # byte-sample keys per hot-range bucket
+    STORAGE_HOT_RANGE_MIN_DENSITY = 2.0  # status only surfaces density >= this
+    STORAGE_HOT_RANGE_STATUS_N = 3  # per-storage top-N in the status gauge
+    DD_WAIT_METRICS_SIZING = True  # DD sizes shards from waitMetrics pushes
+    DD_WAIT_METRICS_TIMEOUT = 30.0  # re-arm cadence when no push arrives (s)
+    # bounded metrics history (runtime/timeseries.py): every hosted
+    # CounterCollection keeps a ring of numeric snapshots, read back via
+    # worker.metricsHistory / cli metrics / trace_analyze --timeline
+    METRICS_HISTORY_ENABLED = True
+    METRICS_HISTORY_INTERVAL = 2.0  # snapshot cadence (s)
+    METRICS_HISTORY_SAMPLES = 120  # ring capacity (points kept per role)
     # client
     # fraction of commits auto-tagged with a transaction-debug id
     # (g_traceBatch sampling; tr.set_debug_id forces one)
@@ -504,3 +523,21 @@ class Knobs:
             self.FUTURE_SLAB_SETTLE = rng.random_choice([True, False])
         if rng.coinflip(0.3):
             self.TLOG_FSYNC_PIPELINE = rng.random_choice([True, False])
+
+    def randomize_storage_metrics(self, rng) -> None:
+        """Keyspace-telemetry knob randomization (ISSUE 20), drawn at the
+        very END of the soak's sequence (after randomize_commit_path) so
+        pinned chaos seeds keep their cluster-shape and workload draws
+        byte-identical. Sampling is drawn both ways so the soak matrix
+        keeps exercising DD's range-scan fallback; the sample factor
+        sweeps dense→sparse; history cadence/capacity sweep tiny rings."""
+        if rng.coinflip(0.3):
+            self.STORAGE_METRICS_SAMPLING = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            self.STORAGE_BYTE_SAMPLE_FACTOR = rng.random_choice([32, 200, 2000])
+        if rng.coinflip(0.3):
+            self.DD_WAIT_METRICS_SIZING = rng.random_choice([True, False])
+        if rng.coinflip(0.25):
+            self.METRICS_HISTORY_INTERVAL = rng.random_choice([0.5, 2.0, 10.0])
+        if rng.coinflip(0.25):
+            self.METRICS_HISTORY_SAMPLES = rng.random_choice([4, 32, 120])

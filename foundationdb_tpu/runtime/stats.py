@@ -185,6 +185,7 @@ class CounterCollection:
         self.band_sets: dict[str, LatencyBands] = {}
         self.gauges: dict[str, object] = {}  # name → zero-arg callable
         self._last_trace = None
+        self.history = None  # MetricsHistory ring, see ensure_history()
 
     def counter(self, name: str) -> Counter:
         c = self.counters.get(name)
@@ -253,3 +254,34 @@ class CounterCollection:
         while True:
             await delay(interval)
             self.trace_now(process)
+
+    def ensure_history(self, capacity: int) -> "object":
+        """Attach (or resize lazily — capacity changes only apply to a
+        fresh ring) the bounded metrics-history ring (ISSUE 20)."""
+        if self.history is None:
+            from .timeseries import MetricsHistory
+
+            self.history = MetricsHistory(capacity)
+        return self.history
+
+    def record_history(self, t: Optional[float] = None) -> None:
+        """Snapshot numeric counters/gauges into the history ring now.
+        No-op until ensure_history() has been called."""
+        if self.history is None:
+            return
+        self.history.record(now() if t is None else t, self.snapshot())
+
+    async def history_loop(self, knobs):
+        """Actor: feed the metrics-history ring at the knob-set cadence
+        (METRICS_HISTORY_INTERVAL / METRICS_HISTORY_SAMPLES). Gated on
+        METRICS_HISTORY_ENABLED so the overhead-sensitive path can turn
+        the whole subsystem off with one knob."""
+        from .futures import delay
+
+        if not getattr(knobs, "METRICS_HISTORY_ENABLED", True):
+            return
+        self.ensure_history(int(knobs.METRICS_HISTORY_SAMPLES))
+        interval = float(knobs.METRICS_HISTORY_INTERVAL)
+        while True:
+            await delay(interval)
+            self.record_history()

@@ -731,6 +731,28 @@ class ClusterController:
                 "read": band_agg("storage", "readLatencyBands"),
                 "resolve": band_agg("resolver", "resolveLatencyBands"),
             },
+            # keyspace telemetry (ISSUE 20): cluster-wide hottest ranges
+            # (each storage's hotRanges gauge is its local top-N; merged
+            # and re-ranked by read÷size density here) plus byte-sample
+            # and waitMetrics-subscription evidence
+            "hot_ranges": sorted(
+                (
+                    dict(r, storage=uid)
+                    for w in workers.values()
+                    for uid, snap in (w.get("metrics") or {}).items()
+                    if snap.get("kind") == "storage"
+                    for r in (snap.get("hotRanges") or [])
+                ),
+                key=lambda r: r.get("density") or 0,
+                reverse=True,
+            )[:5],
+            "byte_sampling": {
+                "bytes_sampled": sq("bytesSampled"),
+                "sample_entries": agg("storage", "sampleEntries"),
+                "hot_range_checks": sq("hotRangeChecks"),
+                "wait_metrics_active": agg("storage", "waitMetricsActive"),
+                "wait_metrics_fired": sq("waitMetricsFired"),
+            },
         }
         txn_out = _committed
         conflicts = _conflicted

@@ -27,7 +27,7 @@ from ..net.sim import Endpoint
 from ..runtime.futures import delay, timeout
 from ..runtime.trace import SevInfo, SevWarn, trace
 from ..runtime.buggify import buggify
-from .interfaces import GetKeyServersRequest, Tokens
+from .interfaces import GetKeyServersRequest, Tokens, WaitMetricsRequest
 from .movekeys import merge_shards, move_shard, split_shard, take_move_keys_lock
 from ..runtime.loop import Cancelled
 
@@ -59,6 +59,12 @@ class DataDistributor:
         # the shard unreadable (e.g. it rebooted and lost an in-flight
         # fetch whose sources are gone) — treated like a dead member
         self._unready: dict = {}
+        # waitMetrics push sizing (ISSUE 20, trackShardBytes): per-shard
+        # byte estimates arrive as threshold-band pushes from the storage
+        # servers' byte sample instead of poll-and-scan rounds
+        self._shard_sizes: dict = {}  # (begin, end) → last pushed estimate
+        self._shard_watches: dict = {}  # (begin, end) → watch actor Task
+        self._no_samples: set = set()  # shards whose servers report unsupported
 
     async def run(self):
         monitor = self.process.spawn(self._failure_monitor())
@@ -80,6 +86,9 @@ class DataDistributor:
             monitor.cancel()  # dies with this DD, not with the process
             if tracker is not None:
                 tracker.cancel()
+            for task in self._shard_watches.values():
+                task.cancel()
+            self._shard_watches.clear()
 
     async def _failure_monitor(self):
         misses = {s.tag: 0 for s in self.storage}
@@ -177,6 +186,70 @@ class DataDistributor:
                     SevWarn, "DDTrackerError", self.process.address, Err=repr(e)
                 )
 
+    async def _watch_shard_metrics(self, begin, end, tags, by_tag):
+        """Per-shard waitMetrics subscription actor (trackShardBytes):
+        the first request carries a (-1, -1) band so the server replies
+        immediately with its current estimate; every reply re-arms a
+        band around the new estimate, capped so the split threshold is
+        always a band edge (crossing DD_SHARD_MAX_BYTES always pushes).
+        A timeout means the estimate stayed in-band — re-arm as-is. An
+        {"unsupported"} reply (sampling off) demotes this shard to the
+        range-scan fallback for this DD generation."""
+        key = (begin, end)
+        band = (-1, -1)
+        while True:
+            target = None
+            for t in tags:
+                if self.alive.get(t, False) and t in by_tag:
+                    target = by_tag[t]
+                    break
+            if target is None:
+                await delay(self.knobs.DD_TRACKER_INTERVAL)
+                continue
+            try:
+                m = await timeout(
+                    self.process.request(
+                        Endpoint(target.address, Tokens.WAIT_METRICS),
+                        WaitMetricsRequest(begin, end, band[0], band[1]),
+                    ),
+                    self.knobs.DD_WAIT_METRICS_TIMEOUT,
+                )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
+            except Exception:
+                await delay(self.knobs.DD_TRACKER_INTERVAL)
+                continue
+            if m is None:
+                continue  # timeout: estimate stayed inside the band; re-arm
+            if m.get("unsupported"):
+                self._no_samples.add(key)
+                return
+            est = int(m.get("bytes") or 0)
+            self._shard_sizes[key] = est
+            delta = max(est // 2, self.knobs.DD_SHARD_MAX_BYTES // 8, 1)
+            lo, hi = max(0, est - delta), est + delta
+            if est <= self.knobs.DD_SHARD_MAX_BYTES < hi:
+                hi = self.knobs.DD_SHARD_MAX_BYTES
+            band = (lo, hi)
+
+    def _reconcile_watches(self, shards, by_tag) -> None:
+        """Keep one watch actor per live shard: cancel watches whose
+        boundaries a split/merge/move erased, spawn watches for new
+        shards (shardTrackers map maintenance in the reference)."""
+        want = {(b, e): tags for b, e, tags in shards}
+        for key, task in list(self._shard_watches.items()):
+            if key not in want:
+                task.cancel()
+                del self._shard_watches[key]
+                self._shard_sizes.pop(key, None)
+                self._no_samples.discard(key)
+        for key, tags in want.items():
+            if key in self._shard_watches or key in self._no_samples:
+                continue
+            self._shard_watches[key] = self.process.spawn(
+                self._watch_shard_metrics(key[0], key[1], tags, by_tag)
+            )
+
     async def _shard_bytes(self, begin, end, tags, by_tag):
         for t in tags:
             if not self.alive.get(t, False) or t not in by_tag:
@@ -200,9 +273,18 @@ class DataDistributor:
     async def _track_once(self):
         shards = await self._walk_shards()
         by_tag = {s.tag: s for s in self.storage}
+        use_push = bool(getattr(self.knobs, "DD_WAIT_METRICS_SIZING", True))
+        if use_push:
+            self._reconcile_watches(shards, by_tag)
         sizes = []
         for begin, end, tags in shards:
-            sizes.append(await self._shard_bytes(begin, end, tags, by_tag))
+            key = (begin, end)
+            if use_push and key not in self._no_samples:
+                # None until the first push lands — skip the shard this
+                # round rather than fall back to a full range scan
+                sizes.append(self._shard_sizes.get(key))
+            else:
+                sizes.append(await self._shard_bytes(begin, end, tags, by_tag))
         # split the largest oversized shard (one structural change per
         # round keeps the tracker from racing its own boundary edits)
         worst_i, worst = None, self.knobs.DD_SHARD_MAX_BYTES

@@ -244,6 +244,22 @@ class WatchValueReply:
 
 
 @dataclass
+class WaitMetricsRequest:
+    """Threshold-band metrics subscription (ISSUE 20): the storage
+    server replies immediately if its sampled byte estimate for
+    [begin, end) is outside [min_bytes, max_bytes], else parks the reply
+    until a sampled mutation pushes the estimate across the band
+    (StorageMetrics.actor.h waitMetrics — DD's trackShardBytes
+    subscribes instead of polling). A (-1, -1) band forces an immediate
+    reply with the current estimate."""
+
+    begin: bytes = b""
+    end: Optional[bytes] = None  # None = end of keyspace
+    min_bytes: int = -1
+    max_bytes: int = -1
+
+
+@dataclass
 class FeedReadRequest:
     """One change-feed page (ISSUE 16): committed per-version diffs for
     [begin, end) above from_version. Long-polls while the range is
@@ -561,6 +577,7 @@ class Tokens:
     GET_SHARD_STATE = "storage.getShardState"
     GET_SHARD_METRICS = "storage.getShardMetrics"
     GET_SPLIT_KEY = "storage.getSplitKey"
+    WAIT_METRICS = "storage.waitMetrics"
     WATCH_VALUE = "storage.watchValue"
     FEED_READ = "storage.feedRead"
     BATCH_GET = "storage.batchGet"

@@ -79,9 +79,11 @@ class Worker:
         p.register(Tokens.WORKER_PING, self._ping)
         p.register(Tokens.WORKER_DESTROY_ROLE, self._destroy_role_req)
         p.register("worker.metrics", self._role_metrics)
+        p.register("worker.metricsHistory", self._metrics_history)
         p.register("worker.systemMetrics", self._system_metrics)
         p.register("process.metrics", self._process_metrics)
         p.register("transport.metrics", self._transport_metrics)
+        p.spawn(self._history_loop())
         from ..runtime.loop import current_loop
         from ..runtime.monitor import system_monitor
 
@@ -188,6 +190,43 @@ class Worker:
                 snap = stats.snapshot(elapsed)
                 snap["kind"] = h.kind
                 out[uid] = snap
+        return out
+
+    async def _history_loop(self):
+        """Feed every hosted role's metrics-history ring (ISSUE 20,
+        runtime/timeseries.py) at the knob-set cadence. One loop covers
+        all roles — roles recruited later simply gain their ring on the
+        next tick (worker-hosted storage runs via run(), not register(),
+        so there is no double-recording)."""
+        from ..runtime.futures import delay
+        from ..runtime.loop import now
+
+        if not getattr(self.knobs, "METRICS_HISTORY_ENABLED", True):
+            return
+        interval = float(self.knobs.METRICS_HISTORY_INTERVAL)
+        capacity = int(self.knobs.METRICS_HISTORY_SAMPLES)
+        while True:
+            await delay(interval)
+            t = now()
+            for h in self.roles.values():
+                stats = getattr(h.obj, "stats", None)
+                if stats is not None:
+                    stats.ensure_history(capacity)
+                    stats.record_history(t)
+
+    async def _metrics_history(self, _req) -> dict:
+        """Every hosted role's metrics-history ring: uid → {kind, points}
+        (the timeline source behind `cli metrics` and trace_analyze
+        --timeline's live mode)."""
+        out = {}
+        for uid, h in self.roles.items():
+            stats = getattr(h.obj, "stats", None)
+            hist = getattr(stats, "history", None) if stats is not None else None
+            if hist is None:
+                continue
+            d = hist.to_dict()
+            d["kind"] = h.kind
+            out[uid] = d
         return out
 
     async def _destroy_role_req(self, uid: str):

@@ -112,6 +112,32 @@ def codec_micro(timeout=120):
     return None
 
 
+def keyspace_micro(timeout=300):
+    """Keyspace-telemetry snapshot (perf --keyspace-micro, ISSUE 20):
+    CPU-only skewed-keyspace sim capturing the sampled byte-estimate
+    accuracy, hot-range verdict, waitMetrics push, and metrics-history
+    depth — embedded in the BENCH JSON next to the codec/kernel
+    snapshots so the telemetry layer's health travels with the number."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.tools.perf",
+             "--keyspace-micro"],
+            capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log("keyspace micro timed out")
+        return None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except ValueError:
+                pass
+    return None
+
+
 def snapshot(result, platform):
     """Merge a device-verified result into BENCH_partial.json (keep best)."""
     best = None
@@ -128,6 +154,9 @@ def snapshot(result, platform):
     micro = codec_micro()
     if micro:
         entry["codec_micro"] = micro
+    ks = keyspace_micro()
+    if ks:
+        entry["keyspace"] = ks
     if best and best.get("vs_baseline", 0) > entry.get("vs_baseline", 0):
         best["superseded_attempt"] = {
             "vs_baseline": entry.get("vs_baseline"),
@@ -233,6 +262,23 @@ def snapshot(result, platform):
                 cm.get("decode_speedup"),
                 cm.get("messages_per_round"),
                 cm.get("byte_identical"),
+            )
+        )
+    # keyspace-telemetry provenance (perf --keyspace-micro, ISSUE 20):
+    # estimate accuracy, hot-range verdict, and the waitMetrics push on
+    # the skewed probe — the sensor layer's health next to the number
+    ksp = entry.get("keyspace") or {}
+    if ksp:
+        bsamp = ksp.get("byte_sample") or {}
+        log(
+            "keyspace: hot_top1=%s est_err%%=%s entries=%s "
+            "waitMetrics_pushed=%s history_pts=%s"
+            % (
+                ksp.get("hot_top1_is_hot_prefix"),
+                (bsamp.get("error_pct") or {}),
+                bsamp.get("entries"),
+                ksp.get("wait_metrics_pushed"),
+                ksp.get("metrics_history_points"),
             )
         )
     rl = entry.get("run_loop") or {}

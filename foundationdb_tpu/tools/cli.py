@@ -205,6 +205,14 @@ class FdbCli:
                 f"{(wa.get('feed_entries_streamed') or {}).get('counter', 0)} "
                 f"feed entries streamed"
             )
+        hot = wl.get("hot_ranges") or []
+        if hot:
+            tops = ", ".join(
+                f"[{r.get('begin', '')!r},{r.get('end', '')!r}) "
+                f"x{r.get('density', 0):.0f}"
+                for r in hot[:3]
+            )
+            lines.append(f"Hot ranges: {tops} (see `hotranges`)")
         tr = (doc.get("transport") or {}).get("total") or {}
         if tr.get("messagesSent"):
             lines.append(
@@ -368,6 +376,109 @@ class FdbCli:
                         f"{snap.get('transactions', 0)} txns, "
                         f"{snap.get('conflicts', 0)} conflicts{extra}"
                     )
+        return "\n".join(lines)
+
+    async def _cmd_hotranges(self, args) -> str:
+        """hotranges [N] — the cluster's hottest key ranges by sampled
+        read-bytes ÷ size density (ISSUE 20; the reference's
+        getReadHotRanges surfaced through status `workload.hot_ranges`),
+        plus the byte-sampling evidence backing the estimates."""
+        n = int(args[0]) if args else 5
+        doc = await management.get_status(self.coordinators, self.db.client)
+        wl = doc.get("workload") or {}
+        hot = wl.get("hot_ranges") or []
+        bs = wl.get("byte_sampling") or {}
+        lines = []
+        if not hot:
+            lines.append(
+                "no hot ranges (sampling off, no reads, or all densities "
+                "under STORAGE_HOT_RANGE_MIN_DENSITY)"
+            )
+        else:
+            lines.append(f"{len(hot[:n])} hot range(s), hottest first:")
+            lines.append(
+                f"{'density':>8}  {'read bytes':>11}  {'size':>9}  "
+                f"{'storage':14s}  range"
+            )
+            for r in hot[:n]:
+                lines.append(
+                    f"{r.get('density', 0):8.1f}  {r.get('read_bytes', 0):11d}  "
+                    f"{r.get('bytes', 0):9d}  {r.get('storage', '?'):14s}  "
+                    f"[{r.get('begin', '')!r}, {r.get('end', '')!r})"
+                )
+        lines.append(
+            f"Byte sample: {(bs.get('sample_entries') or 0)} entries, "
+            f"{(bs.get('bytes_sampled') or {}).get('counter', 0)} bytes sampled, "
+            f"{(bs.get('hot_range_checks') or {}).get('counter', 0)} bucket checks; "
+            f"waitMetrics {(bs.get('wait_metrics_active') or 0)} armed / "
+            f"{(bs.get('wait_metrics_fired') or {}).get('counter', 0)} fired"
+        )
+        return "\n".join(lines)
+
+    async def _cmd_metrics(self, args) -> str:
+        """metrics                    — list roles with metrics history
+        metrics <kind>            — list that kind's recorded counters
+        metrics <kind> <counter>  — sparkline + timeline of the counter
+        Reads every worker's `worker.metricsHistory` ring (ISSUE 20,
+        runtime/timeseries.py) and merges roles of a kind."""
+        from ..net.sim import Endpoint
+        from ..runtime.futures import timeout as _timeout
+        from .trace_analyze import sparkline
+
+        kind = args[0] if args else None
+        counter = args[1] if len(args) > 1 else None
+        doc = await management.get_status(self.coordinators, self.db.client)
+        workers = (doc.get("cluster") or {}).get("workers") or {}
+        rings: dict = {}  # uid → history dict (with "kind")
+        for addr in workers:
+            try:
+                h = await _timeout(
+                    self.db.client.request(
+                        Endpoint(addr, "worker.metricsHistory"), None
+                    ),
+                    2.0,
+                )
+            except Cancelled:
+                raise  # actor-cancelled-swallow
+            except Exception:
+                h = None
+            for uid, d in (h or {}).items():
+                rings[uid] = d
+        if not rings:
+            return "no metrics history (METRICS_HISTORY_ENABLED off, or no points yet)"
+        if kind is None:
+            kinds: dict = {}
+            for d in rings.values():
+                kinds[d.get("kind") or "?"] = kinds.get(d.get("kind") or "?", 0) + 1
+            return "roles with history: " + ", ".join(
+                f"{k} ({n})" for k, n in sorted(kinds.items())
+            )
+        matching = {u: d for u, d in rings.items() if d.get("kind") == kind}
+        if not matching:
+            return f"no `{kind}' roles with metrics history"
+        if counter is None:
+            names: set = set()
+            for d in matching.values():
+                for _t, vals in d.get("points") or []:
+                    names.update(vals)
+            return f"{kind} counters: " + ", ".join(sorted(names))
+        # sum the counter across roles of the kind, per snapshot tick
+        merged: dict = {}  # rounded t → summed value
+        for d in matching.values():
+            for t, vals in d.get("points") or []:
+                if counter in vals:
+                    tk = round(t, 1)
+                    merged[tk] = merged.get(tk, 0) + vals[counter]
+        if not merged:
+            return f"counter `{counter}' not in any {kind} history"
+        pts = sorted(merged.items())
+        vals = [v for _t, v in pts]
+        lines = [
+            f"{kind}.{counter} over {len(pts)} points "
+            f"[t={pts[0][0]:g}..{pts[-1][0]:g}]:",
+            "  " + sparkline(vals),
+            f"  min {min(vals):g}  max {max(vals):g}  last {vals[-1]:g}",
+        ]
         return "\n".join(lines)
 
     async def _cmd_trace(self, args) -> str:

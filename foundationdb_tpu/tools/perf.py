@@ -407,6 +407,117 @@ def run_codec_micro(args) -> dict:
     return report
 
 
+def run_keyspace_micro(args) -> dict:
+    """--keyspace-micro: skewed-keyspace probe of the ISSUE 20 telemetry
+    (server/storage_metrics.py), no DD — one static sim cluster, a bulk
+    cold/ prefix plus a small hot/ prefix taking ~90% of reads, then:
+    sampled per-prefix byte estimates vs the driver's exact counts, the
+    read-hot-range verdict (hot/ must rank top-1), a waitMetrics band
+    armed over hot/ that the write load must push across, and the
+    storage metrics-history ring depth. bench_capture embeds this next
+    to the codec/kernel snapshots."""
+    # tests/sims must never touch a wedged TPU tunnel (memory: axon)
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..client.database import Database
+    from ..net.sim import Endpoint, Sim
+    from ..runtime.futures import spawn, timeout
+    from ..runtime.rng import DeterministicRandom
+    from ..server import Cluster, ClusterConfig
+    from ..server.interfaces import Tokens, WaitMetricsRequest
+
+    sim = Sim(seed=args.seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(n_proxies=1, n_resolvers=1))
+    db = Database(sim, cluster.proxy_addrs)
+    ss = cluster.storages[0]
+    rng = DeterministicRandom(args.seed)
+    exact = {"hot": 0, "cold": 0}
+    report: dict = {"workload": "keyspace_micro", "mode": "sim"}
+
+    async def write_batch(items):
+        async def body(tr):
+            for k, v in items:
+                tr.set(k, v)
+
+        await db.run(body)
+        for k, v in items:
+            pfx = "hot" if k.startswith(b"hot/") else "cold"
+            exact[pfx] += len(k) + len(v)
+
+    async def go():
+        from ..runtime.futures import delay
+
+        # arm a waitMetrics band over hot/ BEFORE the load: the write
+        # traffic must push the estimate across without any scan
+        wait_fut = spawn(
+            timeout(
+                db.client.request(
+                    Endpoint(ss.process.address, Tokens.WAIT_METRICS),
+                    WaitMetricsRequest(b"hot/", b"hot0", 0, 512),
+                ),
+                60.0,
+            )
+        )
+        hot_keys = [f"hot/{i:03d}".encode() for i in range(8)]
+        for base in range(0, 600, 20):
+            await write_batch(
+                [
+                    (f"cold/{base + i:06d}".encode(), bytes(64))
+                    for i in range(20)
+                ]
+            )
+        await write_batch([(k, bytes(256)) for k in hot_keys])
+        # 90%-hot read skew
+        for _ in range(400):
+            key = (
+                rng.random_choice(hot_keys)
+                if rng.random01() < 0.9
+                else f"cold/{rng.random_int(0, 600):06d}".encode()
+            )
+
+            async def body(tr, key=key):
+                return await tr.get(key)
+
+            await db.run(body)
+        push = await wait_fut
+        report["wait_metrics_pushed"] = push is not None and not (
+            push or {}
+        ).get("unsupported")
+        report["wait_metrics_reply"] = push
+        await delay(3 * sim.knobs.METRICS_HISTORY_INTERVAL)  # ring fills
+        return True
+
+    sim.run_until_done(spawn(go()), 36000.0)
+    est = {
+        "hot": ss.metrics.sample_bytes(b"hot/", b"hot0"),
+        "cold": ss.metrics.sample_bytes(b"cold/", b"cold0"),
+    }
+    report["byte_sample"] = {
+        "entries": ss.metrics.sample_entries(),
+        "estimate": est,
+        "exact": exact,
+        "error_pct": {
+            p: round(100.0 * abs(est[p] - exact[p]) / max(exact[p], 1), 2)
+            for p in exact
+        },
+    }
+    hot_ranges = ss.metrics.hot_ranges_status(5)
+    report["hot_ranges"] = hot_ranges
+    report["hot_top1_is_hot_prefix"] = bool(
+        hot_ranges and hot_ranges[0]["begin"].startswith("hot/")
+    )
+    hist = ss.stats.history
+    report["metrics_history_points"] = len(hist) if hist is not None else 0
+    report["wait_metrics_fired"] = ss.stats.counters["waitMetricsFired"].value
+    return report
+
+
 def make_workload(args, db, rng, now_fn=None):
     from ..workloads.readwrite import (
         BulkLoadWorkload,
@@ -795,6 +906,12 @@ def main(argv=None) -> int:
              "timing leg (default 0.4s)",
     )
     ap.add_argument(
+        "--keyspace-micro", action="store_true", dest="keyspace_micro",
+        help="skewed-keyspace telemetry probe (ISSUE 20): sampled byte "
+             "estimates vs exact, hot-range verdict, waitMetrics push, "
+             "metrics-history depth (one static sim cluster)",
+    )
+    ap.add_argument(
         "--transport-legacy", action="store_true", dest="transport_legacy",
         help="tcp-inproc: pin the gen-6-shaped transport (per-message "
              "frames, no loopback) for the A/B leg",
@@ -821,6 +938,9 @@ def main(argv=None) -> int:
 
     if args.codec_micro:
         print(json.dumps(run_codec_micro(args)), flush=True)
+        return 0
+    if args.keyspace_micro:
+        print(json.dumps(run_keyspace_micro(args)), flush=True)
         return 0
     if args.overload_factor > 0:
         report = run_overload(args)

@@ -292,6 +292,12 @@ def run_one(
     # chaos. Restored to defaults after the run so soak state never leaks
     # into the next seed or test.
     knobs.randomize_commit_path(shape_rng)
+    # keyspace-telemetry draws (ISSUE 20) are the NEW end of the sequence
+    # — after randomize_commit_path, same pinned-seed rationale. Sampling
+    # goes both ways so DD's waitMetrics sizing AND its range-scan
+    # fallback both run under chaos; tiny sample factors densify the
+    # byte sample, tiny history rings force eviction.
+    knobs.randomize_storage_metrics(shape_rng)
     from ..net import wire as _wire
     from ..runtime import futures as _futures
 
@@ -334,6 +340,13 @@ def run_one(
             "compiled_codec": bool(knobs.WIRE_COMPILED_CODEC),
             "slab_settle": bool(knobs.FUTURE_SLAB_SETTLE),
             "fsync_pipeline": bool(knobs.TLOG_FSYNC_PIPELINE),
+        },
+        "storage_metrics_armed": {
+            "sampling": bool(knobs.STORAGE_METRICS_SAMPLING),
+            "byte_sample_factor": int(knobs.STORAGE_BYTE_SAMPLE_FACTOR),
+            "wait_metrics_sizing": bool(knobs.DD_WAIT_METRICS_SIZING),
+            "history_interval": float(knobs.METRICS_HISTORY_INTERVAL),
+            "history_samples": int(knobs.METRICS_HISTORY_SAMPLES),
         },
         "workloads": [type(w).__name__ for w in workloads],
         "config": cfg.as_dict(),

@@ -152,6 +152,75 @@ def format_summary(summary: dict) -> str:
     return "\n".join(lines)
 
 
+# -- timeline mode (metrics history, ISSUE 20) ---------------------------------
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list) -> str:
+    """Unicode block sparkline for a numeric series (shared with the
+    `cli metrics` renderer)."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi == lo:
+        return _SPARK_CHARS[0] * len(values)
+    span = hi - lo
+    return "".join(
+        _SPARK_CHARS[min(len(_SPARK_CHARS) - 1, int((v - lo) / span * len(_SPARK_CHARS)))]
+        for v in values
+    )
+
+
+def timeline_series(events: list[dict], counter: str = None) -> dict:
+    """Full per-counter series from the periodic ``*Metrics`` trace
+    events: timeline key (``Type#ID``) → {counter: [(t, value)]}. The
+    offline twin of the live metrics-history ring — `analyze()` keeps
+    only first/last, this keeps every point so --timeline can draw the
+    shape between them. ``counter`` filters to one counter name."""
+    out: dict[str, dict] = {}
+    for e in events:
+        t = e.get("Type", "?")
+        if not t.endswith("Metrics"):
+            continue
+        when = e.get("Time")
+        if not isinstance(when, (int, float)):
+            continue
+        key = f"{t}#{e.get('ID') or e.get('Machine') or ''}"
+        series = out.setdefault(key, {})
+        for k, v in e.items():
+            if k in _META_FIELDS or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, bool) or (counter and k != counter):
+                continue
+            series.setdefault(k, []).append((when, v))
+    return {k: v for k, v in out.items() if v}
+
+
+def format_timeline(tls: dict, counter: str = None, width: int = 60) -> str:
+    """Sparkline timelines for every (role, counter) series — bounded to
+    ``width`` points by tail-keeping (the newest shape is the signal)."""
+    if not tls:
+        return (
+            f"no points for counter {counter!r} in any *Metrics event"
+            if counter
+            else "no *Metrics events (trace too short, or metrics loops off)"
+        )
+    lines = []
+    for key in sorted(tls):
+        series = tls[key]
+        lines.append(f"{key}:")
+        for name in sorted(series):
+            pts = series[name][-width:]
+            vals = [v for _t, v in pts]
+            lines.append(
+                f"  {name:32s} {sparkline(vals)}  "
+                f"[{min(vals):g}..{max(vals):g}] last {vals[-1]:g} "
+                f"({len(series[name])} pts)"
+            )
+    return "\n".join(lines)
+
+
 # -- slow-task mode (run-loop profiler, runtime/profiler.py) -------------------
 
 
@@ -416,8 +485,26 @@ def main(argv=None) -> int:
         dest="slow_tasks",
         help="top-N table of SlowTask events (run-loop blocking attribution)",
     )
+    ap.add_argument(
+        "--timeline",
+        action="store_true",
+        help="sparkline timelines of the periodic *Metrics counters "
+        "(every point, not just first→last deltas)",
+    )
+    ap.add_argument(
+        "--counter",
+        default=None,
+        help="with --timeline: restrict to one counter name",
+    )
     args = ap.parse_args(argv)
     events = load_events(args.trace)
+    if args.timeline:
+        tls = timeline_series(events, counter=args.counter)
+        if args.json:
+            print(json.dumps(tls, indent=1, default=str))
+        else:
+            print(format_timeline(tls, counter=args.counter))
+        return 0
     if args.trace_id:
         print(format_waterfall(events, args.trace_id))
         return 0

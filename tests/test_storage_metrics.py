@@ -1,0 +1,547 @@
+"""Keyspace telemetry (ISSUE 20): byte-sampled size estimates, read-hot
+ranges, waitMetrics push sizing, and the metrics-history ring.
+
+Acceptance battery: sampled estimates within ±20% of exact on a pinned
+seed, same-seed sim runs producing byte-identical sample sets and
+hot-range verdicts, a skewed 90%-to-one-prefix workload surfacing that
+prefix top-1 in `workload.hot_ranges` / `cli hotranges`, a DD sizing
+round issuing ZERO full-range scans while samples are armed (and falling
+back to scans when sampling is off), the flowlint counter pins, the <3%
+sampling+history overhead gate on the smoke readwrite shape, and the
+soak drawing `randomize_storage_metrics` at the very end of the knob
+sequence."""
+
+import json
+import pathlib
+import re
+import time
+
+from foundationdb_tpu.client import management
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.net.sim import Endpoint, Sim
+from foundationdb_tpu.runtime.futures import delay, spawn
+from foundationdb_tpu.runtime.knobs import Knobs
+from foundationdb_tpu.runtime.rng import DeterministicRandom
+from foundationdb_tpu.runtime.timeseries import MetricsHistory
+from foundationdb_tpu.runtime.trace import TraceLog, set_trace_log
+from foundationdb_tpu.server import Cluster
+from foundationdb_tpu.server.cluster import ClusterConfig, DynamicCluster
+from foundationdb_tpu.server.interfaces import (
+    GetKeyServersRequest,
+    Tokens,
+    WaitMetricsRequest,
+)
+from foundationdb_tpu.server.storage_metrics import (
+    StorageServerMetrics,
+    derive_metrics_seed,
+)
+from foundationdb_tpu.tools.cli import FdbCli
+
+
+def _bare_metrics(factor=200, seed=11):
+    """A StorageServerMetrics outside any server: a sim is activated only
+    so now() has a (frozen) clock for the bandwidth windows."""
+    sim = Sim(seed=seed)
+    sim.activate()
+    knobs = Knobs(STORAGE_BYTE_SAMPLE_FACTOR=factor)
+    return sim, StorageServerMetrics(knobs, seed=seed * 7 + 1)
+
+
+async def _walk(db):
+    out = []
+    key = b""
+    while True:
+        reply = await db._proxy_request(
+            Tokens.GET_KEY_SERVERS, GetKeyServersRequest(key=key)
+        )
+        out.append((reply.begin, reply.end, tuple(sorted(reply.tags))))
+        if reply.end is None:
+            return out
+        key = reply.end
+
+
+# -- (a) estimate accuracy + determinism --------------------------------------
+
+
+def test_sampled_estimate_within_20pct_of_exact():
+    """±20% accuracy on the pinned seed: mixed value sizes straddling the
+    sample factor, full-range and sub-range estimates, and clears that
+    take their weight back out."""
+    _sim, m = _bare_metrics(factor=200, seed=11)
+    rng = DeterministicRandom(42)
+    exact = {}
+    for i in range(3000):
+        key = b"k/%05d" % i
+        vlen = rng.random_int(1, 400)
+        m.on_set(key, vlen)
+        exact[key] = len(key) + vlen
+    total = sum(exact.values())
+    est = m.sample_bytes(b"k/", b"k0")
+    assert abs(est - total) / total <= 0.20, (est, total)
+    sub_total = sum(v for k, v in exact.items() if b"k/01" <= k < b"k/02")
+    sub_est = m.sample_bytes(b"k/01", b"k/02")
+    assert abs(sub_est - sub_total) / sub_total <= 0.20, (sub_est, sub_total)
+    # clear-range removes the cleared weight; estimate tracks the shrink
+    m.on_clear_range(b"k/02", b"k/03")
+    assert m.sample_bytes(b"k/02", b"k/03") == 0
+    remaining = sum(v for k, v in exact.items() if not b"k/02" <= k < b"k/03")
+    est2 = m.sample_bytes(b"k/", b"k0")
+    assert abs(est2 - remaining) / remaining <= 0.20, (est2, remaining)
+
+
+def test_factor_one_is_exact_and_overwrites_do_not_double_count():
+    _sim, m = _bare_metrics(factor=1, seed=2)
+    m.on_set(b"a", 100)
+    m.on_set(b"a", 10)  # overwrite: old weight dropped first
+    m.on_set(b"b", 50)
+    assert m.sample_bytes(b"", None) == (1 + 10) + (1 + 50)
+    m.on_clear_key(b"b")
+    assert m.sample_bytes(b"", None) == 11
+    assert m.sample_entries() == 1
+
+
+def test_derive_metrics_seed_is_identity_and_loop_stable():
+    sim = Sim(seed=9)
+    sim.activate()
+    a = derive_metrics_seed("ss-1", 0)
+    b = derive_metrics_seed("ss-1", 0)
+    c = derive_metrics_seed("ss-2", 0)
+    d = derive_metrics_seed("ss-1", 1)
+    assert a == b
+    assert len({a, c, d}) == 3
+    # deriving the seed must not consume the sim's own rng stream
+    before = sim.loop.random.random01()
+    sim2 = Sim(seed=9)
+    sim2.activate()
+    derive_metrics_seed("ss-1", 0)
+    assert sim2.loop.random.random01() == before
+
+
+def _run_sampled_once(seed):
+    """One full sim run (client → proxy → tlog → storage apply path);
+    returns everything the sampler accumulated."""
+    sim = Sim(seed=seed)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(n_proxies=1, n_resolvers=1))
+    db = Database(sim, cluster.proxy_addrs)
+    ss = cluster.storages[0]
+
+    async def go():
+        for base in range(0, 120, 20):
+
+            async def w(tr, base=base):
+                for i in range(20):
+                    tr.set(b"d/%04d" % (base + i), b"v" * 90)
+
+            await db.run(w)
+        for i in range(60):
+
+            async def r(tr, i=i):
+                return await tr.get(b"d/%04d" % ((i * 7) % 120))
+
+            await db.run(r)
+        return True
+
+    assert sim.run_until_done(spawn(go()), 600.0)
+    verdicts = [
+        (h["begin"], h["end"], h["read_bytes"], h["bytes"])
+        for h in ss.metrics.read_hot_ranges(8)
+    ]
+    return dict(ss.metrics._sample), dict(ss.metrics._read), verdicts
+
+
+def test_same_seed_runs_produce_byte_identical_samples_and_verdicts():
+    """PR 6/9 determinism discipline: the sampling RNG is derived, never
+    drawn from the sim stream — two same-seed runs agree byte-for-byte on
+    the sample set, the read sample, and the hot-range verdicts."""
+    assert _run_sampled_once(9) == _run_sampled_once(9)
+
+
+# -- (b) waitMetrics: immediate, parked push, re-arm, sampling-off ------------
+
+
+def test_wait_metrics_immediate_parked_push_and_rearm():
+    _sim, m = _bare_metrics(factor=1, seed=3)  # p=1: exact arithmetic
+    # estimate (0) already outside [5, 10] → immediate reply
+    f = m.wait_metrics(b"a", b"b", 5, 10)
+    assert f.is_ready()
+    assert f.get()["sampled"] and f.get()["bytes"] == 0
+    # inside [0, 100] → parked; covered writes push it across
+    f2 = m.wait_metrics(b"a", b"b", 0, 100)
+    assert not f2.is_ready() and m.wait_active() == 1
+    m.on_set(b"a1", 40)  # 42 bytes, still inside the band
+    m.on_set(b"zz", 500)  # outside [a, b): must not count
+    assert not f2.is_ready()
+    m.on_set(b"a2", 70)  # 42 + 72 = 114 > 100 → crossing fires the push
+    assert f2.is_ready() and m.wait_active() == 0
+    assert f2.get()["bytes"] == 114
+    # a re-arm for the same range displaces (and settles) the older sub
+    f3 = m.wait_metrics(b"a", b"b", 0, 10_000)
+    f4 = m.wait_metrics(b"a", b"b", 0, 10_000)
+    assert f3.is_ready()  # displaced, settled with a fresh estimate
+    assert not f4.is_ready() and m.wait_active() == 1
+
+
+def test_wait_metrics_endpoint_unsupported_when_sampling_off():
+    knobs = Knobs(STORAGE_METRICS_SAMPLING=False)
+    sim = Sim(seed=5, knobs=knobs)
+    sim.activate()
+    cluster = Cluster(sim, ClusterConfig(n_proxies=1, n_resolvers=1))
+    db = Database(sim, cluster.proxy_addrs)
+    ss = cluster.storages[0]
+
+    async def go():
+        async def w(tr):
+            tr.set(b"k1", b"x" * 300)
+
+        await db.run(w)
+        return await db.client.request(
+            Endpoint(ss.process.address, Tokens.WAIT_METRICS),
+            WaitMetricsRequest(b"", None, -1, -1),
+        )
+
+    rep = sim.run_until_done(spawn(go()), 600.0)
+    assert rep == {"unsupported": True}
+    assert ss.metrics.sample_entries() == 0  # sampler really is inert
+
+
+# -- (c) skewed workload → status / cli surfaces ------------------------------
+
+
+def test_skewed_reads_surface_hot_range_in_status_and_cli():
+    """90% of reads land on a 6-key hot/ prefix inside a 200-key cold/
+    bulk: the hot range must rank top-1 in workload.hot_ranges, the
+    byte_sampling evidence block must be live, and the `cli status` /
+    `cli hotranges` / `cli metrics` surfaces must render it."""
+    sim = Sim(seed=3)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim, ClusterConfig(n_storage=1, n_tlogs=1, n_proxies=1)
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+    cli = FdbCli(db, cluster.coordinators)
+    rng = DeterministicRandom(3)
+    hot_keys = [b"hot/%03d" % i for i in range(6)]
+
+    async def go():
+        for base in range(0, 200, 20):
+
+            async def w(tr, base=base):
+                for i in range(20):
+                    tr.set(b"cold/%05d" % (base + i), bytes(100))
+
+            await db.run(w)
+
+        async def wh(tr):
+            for k in hot_keys:
+                tr.set(k, bytes(256))
+
+        await db.run(wh)
+        for _ in range(300):
+            key = (
+                rng.random_choice(hot_keys)
+                if rng.random01() < 0.9
+                else b"cold/%05d" % rng.random_int(0, 200)
+            )
+
+            async def r(tr, key=key):
+                return await tr.get(key)
+
+            await db.run(r)
+        await delay(6.0)  # metrics + history poll cadence
+        doc = await management.get_status(cluster.coordinators, db.client)
+        stext = await cli.execute("status")
+        htext = await cli.execute("hotranges")
+        mlist = await cli.execute("metrics")
+        mtext = await cli.execute("metrics storage epochsApplied")
+        return doc, stext, htext, mlist, mtext
+
+    doc, stext, htext, mlist, mtext = sim.run_until_done(spawn(go()), 600.0)
+    hot = doc["workload"]["hot_ranges"]
+    assert hot, doc["workload"].get("byte_sampling")
+    r0 = hot[0]
+    # top-1 names the hot shard: its range intersects the hot/ prefix
+    assert r0["begin"] < "hot0" and r0["end"] > "hot/", hot
+    assert r0["density"] >= 2.0 and r0["read_bytes"] > 0
+    assert r0["storage"]  # attributed to a storage server
+    bs = doc["workload"]["byte_sampling"]
+    assert bs["sample_entries"] > 0
+    assert bs["bytes_sampled"]["counter"] > 0
+    assert bs["hot_range_checks"]["counter"] > 0
+    # cli surfaces
+    assert "Hot ranges:" in stext, stext
+    assert "hot range" in htext and "Byte sample:" in htext, htext
+    assert "storage" in mlist, mlist
+    assert "storage.epochsApplied over" in mtext, mtext
+
+
+# -- (d) DD sizing: waitMetrics push replaces the scan ------------------------
+
+
+def _count_scans(monkeypatch):
+    from foundationdb_tpu.server.storage import StorageServer
+
+    calls = []
+    orig = StorageServer.get_shard_metrics
+
+    async def counted(self, req):
+        calls.append(req)
+        return await orig(self, req)
+
+    monkeypatch.setattr(StorageServer, "get_shard_metrics", counted)
+    return calls
+
+
+def _bulk_load_until_split(seed, knobs):
+    sim = Sim(seed=seed, knobs=knobs)
+    sim.activate()
+    cluster = DynamicCluster(
+        sim,
+        ClusterConfig(n_storage=2, replication=2, n_tlogs=1),
+        n_coordinators=1,
+    )
+    db = Database.from_coordinators(sim, cluster.coordinators)
+
+    async def body():
+        for batch in range(20):
+
+            async def w(tr, batch=batch):
+                for i in range(10):
+                    tr.set(b"bulk/%03d/%02d" % (batch, i), b"x" * 200)
+
+            await db.run(w)
+        shards = []
+        for _ in range(60):
+            await delay(1.0)
+            shards = await _walk(db)
+            if len(shards) >= 4:
+                break
+        assert len(shards) >= 4, shards
+        await delay(6.0)  # let the CC metrics poll pick up the counters
+        return await management.get_status(cluster.coordinators, db.client)
+
+    return sim.run_until_done(spawn(body()), 600.0)
+
+
+def test_dd_sizing_issues_zero_scans_when_samples_armed(monkeypatch):
+    """The satellite-1 regression: with sampling on (default), a whole
+    bulk-load-to-split sizing sequence must complete on waitMetrics
+    pushes alone — zero storage.getShardMetrics full-range scans — and
+    the pushes must actually have fired."""
+    calls = _count_scans(monkeypatch)
+    knobs = Knobs(
+        DD_SHARD_MAX_BYTES=4096,
+        DD_SHARD_MIN_BYTES=512,
+        DD_TRACKER_INTERVAL=0.5,
+    )
+    doc = _bulk_load_until_split(71, knobs)
+    assert not calls, f"DD fell back to {len(calls)} full-range scans"
+    bs = doc["workload"]["byte_sampling"]
+    assert bs["wait_metrics_fired"]["counter"] > 0, bs
+
+
+def test_dd_falls_back_to_scan_when_sampling_off(monkeypatch):
+    """The no-sample fallback stays alive: sampling disabled → the
+    waitMetrics endpoint reports unsupported and DD sizes (and still
+    splits) through the scan path."""
+    calls = _count_scans(monkeypatch)
+    knobs = Knobs(
+        STORAGE_METRICS_SAMPLING=False,
+        DD_SHARD_MAX_BYTES=4096,
+        DD_SHARD_MIN_BYTES=512,
+        DD_TRACKER_INTERVAL=0.5,
+    )
+    _bulk_load_until_split(71, knobs)
+    assert calls, "sampling off but DD never scanned — sizing went dark"
+
+
+# -- (e) metrics-history ring + timeline tooling ------------------------------
+
+
+def test_metrics_history_ring_bounds_filtering_and_roundtrip():
+    h = MetricsHistory(3)
+    h.record(1.0, {"a": 1, "flag": True, "s": "x", "lst": [1, 2]})
+    h.record(2.0, {"a": 2, "b": 5.5})
+    assert h.names() == ["a", "b"]
+    assert h.series("a") == [(1.0, 1), (2.0, 2)]
+    h.record(3.0, {"a": 3})
+    h.record(4.0, {"a": 4})
+    assert len(h) == 3  # capacity evicts the oldest point
+    assert h.series("a") == [(2.0, 2), (3.0, 3), (4.0, 4)]
+    d = h.to_dict()
+    json.dumps(d)  # wire/JSON-safe by construction
+    assert MetricsHistory.from_dict(d).to_dict() == d
+
+
+def test_trace_analyze_timeline_series_and_sparkline():
+    from foundationdb_tpu.tools import trace_analyze as ta
+
+    assert ta.sparkline([]) == ""
+    assert ta.sparkline([7, 7]) == "▁▁"
+    s = ta.sparkline([0, 1, 2, 3])
+    assert len(s) == 4 and s[0] == "▁" and s[-1] == "█"
+    events = [
+        {"Type": "StorageMetrics", "ID": "ss0", "Time": 1.0,
+         "epochsApplied": 1, "Severity": 10, "flag": True, "name": "x"},
+        {"Type": "StorageMetrics", "ID": "ss0", "Time": 2.0,
+         "epochsApplied": 3},
+        {"Type": "GetValue", "Time": 1.5, "n": 9},  # not *Metrics
+        {"Type": "ProxyMetrics", "Machine": "p0", "Time": 1.0, "commits": 2},
+    ]
+    tls = ta.timeline_series(events)
+    assert tls["StorageMetrics#ss0"]["epochsApplied"] == [(1.0, 1), (2.0, 3)]
+    assert not any("GetValue" in k for k in tls)
+    assert "Severity" not in tls["StorageMetrics#ss0"]  # meta filtered
+    only = ta.timeline_series(events, counter="commits")
+    assert list(only) == ["ProxyMetrics#p0"]
+    text = ta.format_timeline(tls)
+    assert "epochsApplied" in text and "(2 pts)" in text, text
+    assert "no *Metrics events" in ta.format_timeline({})
+
+
+# -- (f) flowlint counter pins ------------------------------------------------
+
+_WORKER = """\
+class Worker:
+    def _make_widget(self, h):
+        from .widget import Widget
+        w = Widget()
+        return w
+"""
+
+_ROLE = """\
+from ..runtime.stats import CounterCollection
+
+class Widget:
+    def __init__(self):
+        self.stats = CounterCollection("widget")
+        self._c_a = self.stats.counter("bytesSampled")
+        self._c_b = self.stats.counter("waitMetricsFired")
+
+    def register_instance(self, process):
+        process.register(f"widget.metrics#{id(self)}", self._metrics)
+
+    async def _metrics(self, _req):  # flowlint: disable=reg-endpoint-span
+        return self.stats.snapshot()
+"""
+
+
+def test_flowlint_pins_storage_telemetry_counters(tmp_path):
+    """Satellite 2: the five telemetry counters are pinned in the real
+    config, and the reg-role-metrics rule flags a dropped pin with the
+    exact `<Class>-counter-<name>` detail (fixture flag + near-miss)."""
+    from foundationdb_tpu.tools.flowlint import lint, load_config
+
+    pinned = set(load_config()["role_required_counters"]["storage"])
+    assert {
+        "bytesSampled",
+        "sampleEntries",
+        "hotRangeChecks",
+        "waitMetricsActive",
+        "waitMetricsFired",
+    } <= pinned, pinned
+
+    def run(role_src):
+        pkg = tmp_path / "foundationdb_tpu" / "server"
+        pkg.mkdir(parents=True, exist_ok=True)
+        (pkg / "worker.py").write_text(_WORKER)
+        (pkg / "widget.py").write_text(role_src)
+        return lint(
+            root=tmp_path,
+            config={
+                "include": ["foundationdb_tpu"],
+                "exclude": [],
+                "sim_scope": [],
+                "host_only": {},
+                "baseline": "baseline.json",
+                "worker_module": "foundationdb_tpu/server/worker.py",
+                "role_exempt": [],
+                "span_roles": [],
+                "role_required_counters": {
+                    "widget": ["bytesSampled", "waitMetricsFired"]
+                },
+            },
+        )
+
+    res = run(_ROLE)
+    assert not res.failing, [f.format() for f in res.failing]
+    dropped = _ROLE.replace(
+        '        self._c_b = self.stats.counter("waitMetricsFired")\n', ""
+    )
+    res = run(dropped)
+    assert any(
+        f.rule == "reg-role-metrics"
+        and f.detail == "Widget-counter-waitMetricsFired"
+        for f in res.failing
+    ), [f.format() for f in res.failing]
+    assert not any(
+        f.detail == "Widget-counter-bytesSampled" for f in res.failing
+    )
+
+
+# -- (g) overhead gate + soak wiring ------------------------------------------
+
+
+def test_telemetry_overhead_under_three_percent_on_smoke_readwrite():
+    """Satellite 6: byte/read sampling + the history loop cost <3% wall
+    time on the smoke readwrite shape (same best-of-3 interleaved harness
+    as the PR 9 profiler gate)."""
+    from foundationdb_tpu.workloads import run_workloads
+    from foundationdb_tpu.workloads.readwrite import ReadWriteWorkload
+
+    def one_run(enabled):
+        set_trace_log(TraceLog())
+        sim = Sim(
+            seed=3,
+            knobs=Knobs(
+                STORAGE_METRICS_SAMPLING=enabled,
+                METRICS_HISTORY_ENABLED=enabled,
+            ),
+        )
+        sim.activate()
+        cluster = Cluster(sim, ClusterConfig(n_proxies=1, n_resolvers=1))
+        db = Database(sim, cluster.proxy_addrs)
+        w = ReadWriteWorkload(
+            db,
+            DeterministicRandom(3),
+            actors=5,
+            txns_per_actor=8,
+            reads_per_txn=9,
+            writes_per_txn=1,
+            keyspace=500,
+        )
+
+        async def go():
+            await run_workloads([w])
+            return True
+
+        t0 = time.perf_counter()
+        assert sim.run_until_done(spawn(go()), 600.0)
+        return time.perf_counter() - t0
+
+    on, off = [], []
+    for _ in range(3):
+        off.append(one_run(False))
+        on.append(one_run(True))
+    assert min(on) <= min(off) * 1.03 + 0.02, (on, off)
+
+
+def test_soak_draws_storage_metrics_last_and_reports_armed():
+    """Satellite 4: randomize_storage_metrics is the VERY end of the soak
+    knob-draw sequence (pinned seeds from earlier PRs reproduce), and the
+    summary reports what it armed."""
+    from foundationdb_tpu.tools import soak as soak_mod
+
+    src = pathlib.Path(soak_mod.__file__).read_text()
+    draws = re.findall(r"knobs\.randomize_(\w+)\(", src)
+    assert draws and draws[-1] == "storage_metrics", draws
+
+    out = soak_mod.run_one(1)
+    armed = out["storage_metrics_armed"]
+    assert set(armed) == {
+        "sampling",
+        "byte_sample_factor",
+        "wait_metrics_sizing",
+        "history_interval",
+        "history_samples",
+    }, armed
